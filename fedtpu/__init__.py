@@ -1,0 +1,37 @@
+"""fedtpu — a TPU-native federated-learning framework.
+
+Re-designed from scratch for TPU (JAX / XLA / pjit / Pallas) with the
+capabilities of the reference gRPC parameter-server system
+(``amolahinge/739-839-federated-learning-using-grpc``):
+
+- Synchronous FedAvg over N federated clients (reference: ``src/server.py:113-179``)
+  becomes a single jitted round step: ``jax.vmap`` of local SGD over a leading
+  ``clients`` axis plus a masked, weighted ``lax.psum`` mean over the device mesh.
+- The 18-architecture CIFAR CNN zoo (reference: ``src/models/``) is rebuilt in
+  ``flax.linen`` (see :mod:`fedtpu.models`).
+- Client failure detection / heartbeats (reference: ``src/server.py:78-101``)
+  become a participation mask feeding the weighted aggregate, plus a real
+  failure-detector state machine on the gRPC edge (:mod:`fedtpu.ft`).
+- Update compression (``-c Y``, reference: ``src/server.py:104-107``) becomes
+  on-device top-k sparsification / int8 quantization with error feedback
+  (:mod:`fedtpu.ops`), applied to client deltas *before* aggregation.
+- gRPC survives only at the cross-pod edge, proto-compatible with the
+  reference's ``federated.proto`` (:mod:`fedtpu.transport`).
+"""
+
+from fedtpu.version import __version__
+
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RoundConfig,
+)
+
+__all__ = [
+    "__version__",
+    "DataConfig",
+    "FedConfig",
+    "OptimizerConfig",
+    "RoundConfig",
+]

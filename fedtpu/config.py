@@ -1,0 +1,100 @@
+"""Central configuration for fedtpu.
+
+The reference scatters configuration across three argparse surfaces and many
+hardcoded constants (reference: ``src/server.py:270-274``, ``src/client.py:56-59``,
+``src/main.py:20-26``; hardcoded round count at ``server.py:120``, model choice at
+``main.py:69``, optimizer at ``main.py:99-101``). fedtpu centralises everything in
+typed, hashable dataclasses so configs can be closed over by jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Per-client local optimizer.
+
+    Defaults mirror the reference trainer: SGD(lr=0.1, momentum=0.9,
+    weight_decay=5e-4) with CosineAnnealingLR(T_max=200)
+    (reference: ``src/main.py:99-101``).
+    """
+
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    # Cosine annealing horizon in *rounds* (the reference steps its scheduler
+    # per epoch; in federated mode one round == one local epoch).
+    cosine_t_max: int = 200
+    nesterov: bool = False
+
+    def lr_at(self, round_idx) -> float:
+        """Cosine-annealed learning rate for a given round (traceable)."""
+        import jax.numpy as jnp
+
+        t = jnp.minimum(round_idx, self.cosine_t_max)
+        return self.learning_rate * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * t / self.cosine_t_max)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset + partitioning.
+
+    ``partition='round_robin'`` reproduces the reference's shard rule where
+    client ``rank`` keeps batch ``i`` iff ``(i + 1) % world == rank``
+    (reference: ``src/main.py:141-144``). Other partitioners (iid, dirichlet)
+    cover the BASELINE.md parity configs.
+    """
+
+    dataset: str = "cifar10"  # cifar10 | cifar100 | mnist | synthetic
+    batch_size: int = 128  # reference: src/main.py:51
+    eval_batch_size: int = 100  # reference: src/main.py:56
+    partition: str = "round_robin"  # round_robin | iid | dirichlet
+    dirichlet_alpha: float = 0.5
+    augment: bool = True  # random crop + flip (reference: src/main.py:37-42)
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Federated topology + algorithm."""
+
+    num_clients: int = 2  # reference default: two clients (src/server.py:281-282)
+    num_rounds: int = 20  # reference: src/server.py:120
+    local_epochs: int = 1  # reference: one epoch per StartTrain (src/client.py:17)
+    algorithm: str = "fedavg"  # fedavg | fedprox
+    fedprox_mu: float = 0.0
+    # Uniform (unweighted) averaging matches the reference aggregator
+    # (src/server.py:163-171); weighted=True uses per-client example counts.
+    weighted: bool = True
+    # Client sampling fraction per round (1.0 == all clients, reference behavior).
+    participation_fraction: float = 1.0
+    # Compression of client deltas before aggregation (parity with -c Y,
+    # reference: src/server.py:104-107). none | topk | int8
+    compression: str = "none"
+    topk_fraction: float = 0.01
+    error_feedback: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundConfig:
+    """Everything a single jitted round step needs, bundled + hashable."""
+
+    model: str = "MobileNet"  # reference default: src/main.py:69
+    num_classes: int = 10
+    image_size: Tuple[int, int, int] = (32, 32, 3)
+    opt: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    fed: FedConfig = dataclasses.field(default_factory=FedConfig)
+    # Steps of local SGD per round per client; with static shapes this is the
+    # padded maximum — shorter shards are masked (see fedtpu.core.client).
+    steps_per_round: int = 8
+    dtype: str = "float32"  # compute dtype for activations; params stay f32
+    mesh_axis: str = "clients"
+
+
+DEFAULT_ROUND_CONFIG = RoundConfig()

@@ -1,0 +1,20 @@
+from fedtpu.core.engine import Federation
+from fedtpu.core.round import (
+    FederatedState,
+    RoundBatch,
+    RoundMetrics,
+    init_state,
+    make_round_step,
+)
+from fedtpu.core.client import make_eval_fn, make_local_update
+
+__all__ = [
+    "Federation",
+    "FederatedState",
+    "RoundBatch",
+    "RoundMetrics",
+    "init_state",
+    "make_round_step",
+    "make_eval_fn",
+    "make_local_update",
+]

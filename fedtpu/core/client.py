@@ -1,0 +1,163 @@
+"""Per-client local training.
+
+The reference runs one local epoch per round per client process: reload the
+global checkpoint, iterate the round-robin-sharded loader, forward/backward/
+SGD-step per batch, save weights (``src/main.py:128-165``). fedtpu's
+equivalent is a pure function of (global model, persistent client state, the
+round's batches): a ``lax.scan`` over local steps that XLA compiles into one
+fused program, designed to sit under ``jax.vmap`` with the leading ``clients``
+axis mapped — every simulated client trains simultaneously on its own slice of
+the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedtpu.config import RoundConfig
+from fedtpu.core import optim
+from fedtpu.utils import trees
+
+Pytree = Any
+
+
+class ClientOutput(NamedTuple):
+    params: Pytree       # locally-updated weights
+    batch_stats: Pytree  # locally-updated BN running stats
+    opt_state: optim.SGDState
+    loss: jnp.ndarray    # mean masked loss over the round
+    accuracy: jnp.ndarray
+    num_steps: jnp.ndarray
+
+
+def make_local_update(
+    apply_fn: Callable,
+    cfg: RoundConfig,
+) -> Callable:
+    """Build the single-client local-epoch function.
+
+    ``apply_fn(variables, x, train, mutable)`` is the flax ``Module.apply``.
+    The returned function is pure and vmappable:
+
+        local_update(global_params, global_stats, opt_state, xs, ys,
+                     step_mask, rng, round_idx) -> ClientOutput
+
+    with ``xs: [steps, batch, ...]``, ``ys: [steps, batch]``,
+    ``step_mask: [steps]`` (False steps are no-ops so ragged shards keep
+    static shapes).
+    """
+    mu = cfg.fed.fedprox_mu if cfg.fed.algorithm == "fedprox" else 0.0
+    compute_dtype = jnp.dtype(cfg.dtype)
+    # Random crop + flip for CIFAR-style training, fused into the jitted step
+    # (the reference augments on the host via torchvision, src/main.py:37-42).
+    use_augment = cfg.data.augment and cfg.data.dataset in ("cifar10", "cifar100")
+
+    def loss_fn(params, batch_stats, global_params, x, y, rng):
+        if use_augment:
+            from fedtpu.data.augment import augment_batch
+
+            aug_rng, rng = jax.random.split(rng)
+            x = augment_batch(aug_rng, x)
+        variables = {"params": params, "batch_stats": batch_stats}
+        logits, updated = apply_fn(
+            variables,
+            x.astype(compute_dtype),
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+        logits = logits.astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        loss = ce
+        if mu > 0.0:
+            # FedProx proximal term: mu/2 * ||w - w_global||^2 keeps local
+            # iterates near the round's global model (BASELINE config 3).
+            loss = loss + 0.5 * mu * trees.tree_sq_norm(
+                trees.tree_sub(params, global_params)
+            )
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, (updated.get("batch_stats", batch_stats), ce, acc)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_update(
+        global_params: Pytree,
+        global_stats: Pytree,
+        opt_state: optim.SGDState,
+        xs: jnp.ndarray,
+        ys: jnp.ndarray,
+        step_mask: jnp.ndarray,
+        rng: jax.Array,
+        round_idx: jnp.ndarray,
+    ) -> ClientOutput:
+        lr = cfg.opt.lr_at(round_idx)
+
+        def one_step(carry, batch):
+            params, stats, ostate = carry
+            x, y, live, step_rng = batch
+            (loss, (new_stats, ce, acc)), grads = grad_fn(
+                params, stats, global_params, x, y, step_rng
+            )
+            new_params, new_ostate = optim.apply(params, grads, ostate, lr, cfg.opt)
+            # Masked steps (padding of ragged shards / dead clients) change
+            # nothing — the reference equivalent is the client simply not
+            # having that batch.
+            live_f = live.astype(jnp.float32)
+            params = jax.tree.map(
+                lambda new, old: jnp.where(live, new, old), new_params, params
+            )
+            stats = jax.tree.map(
+                lambda new, old: jnp.where(live, new, old), new_stats, stats
+            )
+            ostate = jax.tree.map(
+                lambda new, old: jnp.where(live, new, old), new_ostate, ostate
+            )
+            return (params, stats, ostate), (ce * live_f, acc * live_f, live_f)
+
+        steps = xs.shape[0]
+        step_rngs = jax.random.split(rng, steps)
+        (params, stats, ostate), (ces, accs, lives) = jax.lax.scan(
+            one_step,
+            (global_params, global_stats, opt_state),
+            (xs, ys, step_mask, step_rngs),
+        )
+        n = jnp.maximum(jnp.sum(lives), 1.0)
+        return ClientOutput(
+            params=params,
+            batch_stats=stats,
+            opt_state=ostate,
+            loss=jnp.sum(ces) / n,
+            accuracy=jnp.sum(accs) / n,
+            num_steps=jnp.sum(lives),
+        )
+
+    return local_update
+
+
+def make_eval_fn(apply_fn: Callable, cfg: RoundConfig) -> Callable:
+    """Batched evaluation of a model snapshot (parity: ``src/main.py:167-191``,
+    the eval the reference runs on every client after each SendModel)."""
+
+    def eval_step(params, batch_stats, x, y):
+        variables = {"params": params, "batch_stats": batch_stats}
+        logits = apply_fn(variables, x, train=False, mutable=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        )
+        correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return ce.sum(), correct.sum()
+
+    @jax.jit
+    def evaluate(params, batch_stats, xs, ys):
+        """xs: [num_batches, batch, ...] — returns (mean_loss, accuracy)."""
+        losses, corrects = jax.lax.map(
+            lambda b: eval_step(params, batch_stats, b[0], b[1]), (xs, ys)
+        )
+        n = ys.size
+        return jnp.sum(losses) / n, jnp.sum(corrects) / n
+
+    return evaluate

@@ -1,0 +1,192 @@
+"""High-level federated training engine.
+
+The user-facing replacement for the reference's ``run()`` orchestration
+(``src/server.py:113-153``): builds model + data + round step from a
+:class:`fedtpu.config.RoundConfig`, then drives rounds. Each round is one
+jitted call; data for the round is prepared on the host (static-shape batch
+tensors) and donated to the device.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtpu import models as model_zoo
+from fedtpu.config import RoundConfig
+from fedtpu.core.round import (
+    FederatedState,
+    RoundBatch,
+    RoundMetrics,
+    init_state,
+    make_round_step,
+)
+from fedtpu.core.client import make_eval_fn
+from fedtpu.data import dataset_info, load, partition
+from fedtpu.utils.metrics import MetricsLogger
+
+
+class Federation:
+    """Synchronous federated training over simulated clients on one program.
+
+    Capabilities map (reference → here):
+      - client registry + ranks (``src/server.py:281-282,126-129``) →
+        the ``clients`` array axis; ``alive`` mask ↔ heartbeat status.
+      - StartTrain fan-out / join barrier (``src/server.py:124-135``) →
+        ``vmap`` inside one jitted round step.
+      - ``allreduce()`` checkpoint averaging (``src/server.py:155-179``) →
+        on-device masked weighted mean.
+    """
+
+    def __init__(
+        self,
+        cfg: RoundConfig,
+        seed: int = 0,
+        compressor: Optional[Callable] = None,
+        data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    ):
+        self.cfg = cfg
+        shape, n_classes = dataset_info(cfg.data.dataset)
+        if cfg.num_classes != n_classes:
+            raise ValueError(
+                f"cfg.num_classes={cfg.num_classes} but dataset "
+                f"'{cfg.data.dataset}' has {n_classes} classes — set "
+                f"RoundConfig(num_classes={n_classes})"
+            )
+        if cfg.fed.compression != "none" and compressor is None:
+            # Wired through fedtpu.ops.compression; constructing from the
+            # config string lands with that module.
+            from fedtpu.ops.compression import make_compressor
+
+            compressor = make_compressor(cfg.fed)
+        if cfg.fed.local_epochs != 1:
+            raise NotImplementedError(
+                "local_epochs != 1: fold extra epochs into steps_per_round "
+                "(steps_per_round = local_epochs * shard_batches)"
+            )
+        self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
+
+        if data is None:
+            images, labels = load(cfg.data.dataset, "train", seed=cfg.data.seed)
+        else:
+            images, labels = data
+        self.images, self.labels = images, labels
+
+        n = cfg.fed.num_clients
+        if cfg.data.partition == "round_robin":
+            idx, mask = partition.round_robin(len(images), n, cfg.data.batch_size)
+        elif cfg.data.partition == "iid":
+            idx, mask = partition.iid(len(images), n, seed=cfg.data.seed)
+        elif cfg.data.partition == "dirichlet":
+            idx, mask = partition.dirichlet(
+                labels, n, alpha=cfg.data.dirichlet_alpha, seed=cfg.data.seed
+            )
+        else:
+            raise ValueError(f"unknown partition {cfg.data.partition}")
+        self.client_idx, self.client_mask = idx, mask
+        self.weights = jnp.asarray(partition.shard_sizes(mask))
+
+        sample = jnp.zeros((1,) + tuple(images.shape[1:]), jnp.float32)
+        self.state: FederatedState = init_state(
+            self.model, cfg, jax.random.PRNGKey(seed), sample
+        )
+        self._round_step = jax.jit(
+            make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
+        )
+        self._evaluate = make_eval_fn(self.model.apply, cfg)
+        self.alive = np.ones((n,), bool)
+
+    # ---------------------------------------------------------------- data
+    def round_batch(self, round_idx: int) -> RoundBatch:
+        """Materialise this round's static-shape batch tensors."""
+        cfg = self.cfg
+        x, y, step_mask = partition.make_client_batches(
+            self.images,
+            self.labels,
+            self.client_idx,
+            self.client_mask,
+            cfg.data.batch_size,
+            cfg.steps_per_round,
+            seed=cfg.data.seed + round_idx,
+            shuffle=cfg.data.partition != "round_robin",
+        )
+        alive = self.alive.copy()
+        frac = cfg.fed.participation_fraction
+        if frac < 1.0:
+            # Client sampling: each round a random fraction of the *live*
+            # clients participates (standard FL subsampling; the reference
+            # always uses every live client).
+            rng = np.random.default_rng(cfg.data.seed * 7919 + round_idx)
+            live = np.flatnonzero(alive)
+            k = max(1, int(round(frac * len(live))))
+            keep = rng.choice(live, size=k, replace=False)
+            alive = np.zeros_like(alive)
+            alive[keep] = True
+        return RoundBatch(
+            x=jnp.asarray(x),
+            y=jnp.asarray(y),
+            step_mask=jnp.asarray(step_mask),
+            weights=self.weights,
+            alive=jnp.asarray(alive),
+        )
+
+    # --------------------------------------------------------------- rounds
+    def step(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
+        r = int(self.state.round_idx)
+        if batch is None:
+            batch = self.round_batch(r)
+        self.state, metrics = self._round_step(self.state, batch)
+        return metrics
+
+    def run(
+        self,
+        num_rounds: Optional[int] = None,
+        logger: Optional[MetricsLogger] = None,
+        eval_every: int = 0,
+        eval_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    ) -> RoundMetrics:
+        num_rounds = num_rounds or self.cfg.fed.num_rounds
+        metrics = None
+        self.eval_history = []
+        for r in range(num_rounds):
+            t0 = time.time()
+            metrics = self.step()
+            rec = {
+                "loss": metrics.loss,
+                "acc": metrics.accuracy,
+                "active": metrics.num_active,
+                "round_s": time.time() - t0,
+            }
+            if eval_every and (r + 1) % eval_every == 0 and eval_data is not None:
+                te_loss, te_acc = self.evaluate(*eval_data)
+                rec["test_loss"], rec["test_acc"] = te_loss, te_acc
+                self.eval_history.append((r, te_loss, te_acc))
+            if logger is not None:
+                logger.log(r, **rec)
+        return metrics
+
+    # ----------------------------------------------------------------- eval
+    def evaluate(self, images: np.ndarray, labels: np.ndarray):
+        """Evaluate the current global model (parity: ``src/main.py:167-191``)."""
+        bs = self.cfg.data.eval_batch_size
+        nb = len(images) // bs
+        if nb == 0:
+            raise ValueError(
+                f"eval set of {len(images)} examples is smaller than "
+                f"eval_batch_size={bs}"
+            )
+        xs = jnp.asarray(images[: nb * bs]).reshape((nb, bs) + images.shape[1:])
+        ys = jnp.asarray(labels[: nb * bs]).reshape((nb, bs))
+        loss, acc = self._evaluate(self.state.params, self.state.batch_stats, xs, ys)
+        return float(loss), float(acc)
+
+    # ------------------------------------------------------- fault injection
+    def set_alive(self, client: int, alive: bool) -> None:
+        """Mark a simulated client dead/alive (the reference flips
+        ``clients[addr]`` on RpcError / heartbeat success,
+        ``src/server.py:59-62,95-99``)."""
+        self.alive[client] = alive

@@ -1,0 +1,5 @@
+from fedtpu.data import partition
+from fedtpu.data.datasets import dataset_info, load
+from fedtpu.data.augment import augment_batch
+
+__all__ = ["partition", "load", "dataset_info", "augment_batch"]

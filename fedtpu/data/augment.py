@@ -1,0 +1,35 @@
+"""On-device data augmentation.
+
+The reference augments on the host via torchvision transforms — random 32x32
+crop with padding 4 plus horizontal flip (``src/main.py:37-42``). fedtpu runs
+the same augmentation *inside* the jitted step as pure jnp ops, so it fuses
+into the training program and costs no host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def augment_batch(rng: jax.Array, x: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
+    """Random crop (zero-pad) + horizontal flip for an NHWC batch.
+
+    Divergence note: torchvision pads raw pixel 0 *before* normalisation
+    (reference transform order, ``src/main.py:37-42``); here the pad is 0 in
+    normalised space (≈ the mean pixel) — immaterial for accuracy parity.
+    """
+    n, h, w, c = x.shape
+    crop_rng, flip_rng = jax.random.split(rng)
+    padded = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
+
+    offs = jax.random.randint(crop_rng, (n, 2), 0, 2 * pad + 1)
+
+    def crop_one(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+    cropped = jax.vmap(crop_one)(padded, offs)
+
+    flip = jax.random.bernoulli(flip_rng, 0.5, (n,))
+    flipped = jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)
+    return flipped

@@ -1,0 +1,147 @@
+"""Dataset loading.
+
+The reference pulls CIFAR-10 via torchvision with download-on-import
+(``src/main.py:48-56``). This environment has no network egress and no
+torchvision, so fedtpu reads the standard on-disk formats directly when
+present (CIFAR python pickles, MNIST idx files) and otherwise synthesises a
+deterministic, class-structured surrogate with the same shapes/statistics —
+sufficient for throughput benchmarks and for learning-dynamics tests (the
+synthetic task is genuinely learnable: class-conditional means + noise).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Normalisation constants used by the reference transform (src/main.py:39-47).
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
+
+_SEARCH_DIRS = (
+    os.environ.get("FEDTPU_DATA_DIR", ""),
+    "./data",
+    os.path.expanduser("~/data"),
+    "/data",
+)
+
+
+def _find(*names: str) -> Optional[str]:
+    for d in _SEARCH_DIRS:
+        if not d:
+            continue
+        for n in names:
+            p = os.path.join(d, n)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def _synthetic(
+    num: int, shape: Tuple[int, ...], num_classes: int, seed: int, split: str = "train"
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional Gaussian images: learnable, deterministic, no IO.
+
+    The class prototypes depend only on ``seed`` (the dataset identity), so
+    train and test splits come from the *same* task; only labels/noise differ
+    per split.
+    """
+    proto_rng = np.random.default_rng(seed)
+    protos = proto_rng.normal(0.0, 1.0, size=(num_classes,) + shape).astype(np.float32)
+    rng = np.random.default_rng(seed + (1_000_003 if split == "test" else 0) + 1)
+    labels = rng.integers(0, num_classes, size=num).astype(np.int32)
+    x = protos[labels] + 0.5 * rng.normal(0.0, 1.0, size=(num,) + shape).astype(
+        np.float32
+    )
+    return x, labels
+
+
+def load_cifar10(split: str = "train", seed: int = 0):
+    """CIFAR-10 as float32 NHWC in [-2.5, 2.5] (normalised), labels int32."""
+    root = _find("cifar-10-batches-py")
+    n = 50000 if split == "train" else 10000
+    if root is None:
+        return _synthetic(n, (32, 32, 3), 10, seed, split)
+    files = (
+        [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]
+    )
+    xs, ys = [], []
+    for f in files:
+        with open(os.path.join(root, f), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        xs.append(d[b"data"])
+        ys.extend(d[b"labels"])
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x = (x.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+    return x, np.asarray(ys, np.int32)
+
+
+def load_cifar100(split: str = "train", seed: int = 0):
+    root = _find("cifar-100-python")
+    n = 50000 if split == "train" else 10000
+    if root is None:
+        return _synthetic(n, (32, 32, 3), 100, seed + 10, split)
+    with open(os.path.join(root, split if split != "train" else "train"), "rb") as fh:
+        d = pickle.load(fh, encoding="bytes")
+    x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x = (x.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+    return x, np.asarray(d[b"fine_labels"], np.int32)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fh:
+        magic = struct.unpack(">I", fh.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, fh.read(4 * ndim))
+        return np.frombuffer(fh.read(), np.uint8).reshape(dims)
+
+
+def load_mnist(split: str = "train", seed: int = 0):
+    """MNIST as float32 [N, 28, 28, 1] normalised, labels int32."""
+    prefix = "train" if split == "train" else "t10k"
+    img = _find(f"{prefix}-images-idx3-ubyte", f"{prefix}-images-idx3-ubyte.gz",
+                f"MNIST/raw/{prefix}-images-idx3-ubyte")
+    lbl = _find(f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels-idx1-ubyte.gz",
+                f"MNIST/raw/{prefix}-labels-idx1-ubyte")
+    n = 60000 if split == "train" else 10000
+    if img is None or lbl is None:
+        x, y = _synthetic(n, (28, 28, 1), 10, seed + 20, split)
+        return x, y
+    x = _read_idx(img).astype(np.float32)[..., None]
+    x = (x / 255.0 - MNIST_MEAN) / MNIST_STD
+    return x, _read_idx(lbl).astype(np.int32)
+
+
+_LOADERS = {
+    "cifar10": (load_cifar10, (32, 32, 3), 10),
+    "cifar100": (load_cifar100, (32, 32, 3), 100),
+    "mnist": (load_mnist, (28, 28, 1), 10),
+    "synthetic": (None, (32, 32, 3), 10),
+}
+
+
+def load(dataset: str, split: str = "train", seed: int = 0, num: Optional[int] = None):
+    """Load ``(images, labels)`` for a named dataset; optionally truncate."""
+    if dataset not in _LOADERS:
+        raise KeyError(f"unknown dataset '{dataset}'; have {sorted(_LOADERS)}")
+    loader, shape, classes = _LOADERS[dataset]
+    if loader is None:
+        x, y = _synthetic(num or 8192, shape, classes, seed, split)
+    else:
+        x, y = loader(split, seed)
+    if num is not None:
+        x, y = x[:num], y[:num]
+    return x, y
+
+
+def dataset_info(dataset: str) -> Tuple[Tuple[int, ...], int]:
+    """(input_shape, num_classes) for a named dataset."""
+    _, shape, classes = _LOADERS[dataset]
+    return shape, classes

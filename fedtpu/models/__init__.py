@@ -1,0 +1,31 @@
+"""fedtpu model zoo — flax.linen rebuilds of the reference CIFAR zoo
+(``src/models/__init__.py:1-18``) plus the BASELINE parity models.
+
+Constructor names mirror the reference exports so users of the reference find
+the same surface: ``MobileNet()``, ``ResNet18()``, ``VGG('VGG19')``, ...
+"""
+
+from fedtpu.models.registry import available, create, register
+
+from fedtpu.models.mlp import MLP
+from fedtpu.models.smallcnn import SmallCNN
+from fedtpu.models.lenet import LeNet
+from fedtpu.models.mobilenet import MobileNet
+from fedtpu.models.resnet import ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from fedtpu.models.vgg import VGG
+
+__all__ = [
+    "available",
+    "create",
+    "register",
+    "MLP",
+    "SmallCNN",
+    "LeNet",
+    "MobileNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "VGG",
+]

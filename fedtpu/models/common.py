@@ -1,0 +1,46 @@
+"""Shared building blocks for the CIFAR zoo.
+
+All models take NHWC inputs (TPU-friendly layout: the channel dimension lands
+on the 128-wide lane axis) and return ``[batch, num_classes]`` logits. Batch
+statistics live in a ``batch_stats`` collection so that, under FedAvg, they are
+part of the aggregated state exactly as the reference averages BN running
+stats alongside weights (``src/server.py:163-171``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# Conv with PyTorch-style default initialisation is unnecessary; flax defaults
+# (lecun_normal) are fine for parity-by-accuracy. Bias-free convs before BN
+# mirror the reference blocks (e.g. src/models/mobilenet.py:15-20).
+conv3x3 = partial(nn.Conv, kernel_size=(3, 3), use_bias=False, padding=1)
+conv1x1 = partial(nn.Conv, kernel_size=(1, 1), use_bias=False, padding=0)
+
+
+def batch_norm(train: bool) -> nn.Module:
+    """BatchNorm matching torch ``nn.BatchNorm2d`` defaults: torch momentum
+    0.1 corresponds to flax momentum 0.9 (flax keeps
+    ``momentum * old + (1 - momentum) * new``)."""
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    """Mean over the spatial dims of an NHWC tensor."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def max_pool(x, window: int, stride: int | None = None, padding: str = "VALID"):
+    stride = stride or window
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
+
+
+def avg_pool(x, window: int, stride: int | None = None, padding: str = "VALID"):
+    stride = stride or window
+    return nn.avg_pool(x, (window, window), strides=(stride, stride), padding=padding)

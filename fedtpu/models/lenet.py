@@ -1,0 +1,33 @@
+"""LeNet-5 for 32x32 inputs (parity: reference ``src/models/lenet.py``).
+
+Two 5x5 valid convs with 2x2 max-pools, then 120/84/num_classes dense head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedtpu.models.common import max_pool
+from fedtpu.models.registry import register
+
+
+class LeNetModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(6, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = max_pool(x, 2)
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = max_pool(x, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("lenet")
+def LeNet(num_classes: int = 10) -> nn.Module:
+    return LeNetModule(num_classes=num_classes)

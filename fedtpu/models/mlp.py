@@ -1,0 +1,30 @@
+"""2-layer MLP — the BASELINE.md config-1 model (MNIST).
+
+Not in the reference zoo (which is CNN-only); included because the driver's
+parity config 1 is "FedAvg 2-layer MLP on MNIST, 2 clients, IID split".
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.registry import register
+
+
+class MLPModule(nn.Module):
+    num_classes: int = 10
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+@register("mlp")
+def MLP(num_classes: int = 10, hidden: int = 256) -> nn.Module:
+    return MLPModule(num_classes=num_classes, hidden=hidden)

@@ -1,0 +1,77 @@
+"""MobileNet(v1) for CIFAR — the reference's default architecture
+(parity: reference ``src/models/mobilenet.py``; selected at ``src/main.py:69``
+and hardcoded into the aggregator at ``src/server.py:158``).
+
+Depthwise-separable blocks: 3x3 depthwise conv + BN + ReLU, then 1x1 pointwise
+conv + BN + ReLU. Config (64, (128,2), 128, (256,2), 256, (512,2), 512 x 5,
+(1024,2), 1024) after a 3x3/32 stem; global pool + dense head.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+_CFG: Sequence[Union[int, Tuple[int, int]]] = (
+    64,
+    (128, 2),
+    128,
+    (256, 2),
+    256,
+    (512, 2),
+    512,
+    512,
+    512,
+    512,
+    512,
+    (1024, 2),
+    1024,
+)
+
+
+class DepthwiseSeparable(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        # Depthwise: one 3x3 filter per input channel.
+        x = nn.Conv(
+            in_ch,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=in_ch,
+            use_bias=False,
+        )(x)
+        x = batch_norm(train)(x)
+        x = nn.relu(x)
+        # Pointwise expansion.
+        x = conv1x1(self.features)(x)
+        x = batch_norm(train)(x)
+        return nn.relu(x)
+
+
+class MobileNetModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(32, strides=(1, 1))(x)
+        x = batch_norm(train)(x)
+        x = nn.relu(x)
+        for entry in _CFG:
+            features, stride = (entry, 1) if isinstance(entry, int) else entry
+            x = DepthwiseSeparable(features, stride)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("mobilenet")
+def MobileNet(num_classes: int = 10) -> nn.Module:
+    return MobileNetModule(num_classes=num_classes)

@@ -1,0 +1,43 @@
+"""Model registry.
+
+The reference exposes its zoo through star-imports of constructor functions
+(``src/models/__init__.py:1-18``) and hardcodes the active architecture in two
+places (``src/main.py:69``, ``src/server.py:158``). fedtpu keeps the same
+constructor-style surface (``MobileNet()``, ``ResNet18()``, ``VGG('VGG19')``)
+but backs it with a string registry so the architecture is a config value, not
+an edit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+
+_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
+
+
+def register(name: str):
+    def deco(ctor: Callable[..., nn.Module]):
+        _REGISTRY[name.lower()] = ctor
+        return ctor
+
+    return deco
+
+
+def create(name: str, num_classes: int = 10, **kwargs) -> nn.Module:
+    """Build a model by registry name (case-insensitive).
+
+    Accepts both plain names (``"mobilenet"``) and the reference's constructor
+    spellings (``"MobileNet"``, ``"ResNet18"``, ``"VGG19"``).
+    """
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"unknown model '{name}'; available: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key](num_classes=num_classes, **kwargs)
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)

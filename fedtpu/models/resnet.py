@@ -1,0 +1,102 @@
+"""CIFAR ResNet family (parity: reference ``src/models/resnet.py``).
+
+BasicBlock (two 3x3 convs, expansion 1) and Bottleneck (1x1-3x3-1x1,
+expansion 4) residual stages over widths (64, 128, 256, 512) with strides
+(1, 2, 2, 2), 3x3/64 stem, global pool + dense head. Exported constructors
+match the reference: ResNet18/34/50/101/152 (``src/models/resnet.py:107-124``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.features * self.expansion
+        residual = x
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(x)
+        y = batch_norm(train)(y)
+        y = nn.relu(y)
+        y = conv3x3(self.features)(y)
+        y = batch_norm(train)(y)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            residual = conv1x1(out_ch, strides=(self.stride, self.stride))(x)
+            residual = batch_norm(train)(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.features * self.expansion
+        residual = x
+        y = conv1x1(self.features)(x)
+        y = batch_norm(train)(y)
+        y = nn.relu(y)
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(y)
+        y = batch_norm(train)(y)
+        y = nn.relu(y)
+        y = conv1x1(out_ch)(y)
+        y = batch_norm(train)(y)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            residual = conv1x1(out_ch, strides=(self.stride, self.stride))(x)
+            residual = batch_norm(train)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetModule(nn.Module):
+    block: Type[nn.Module]
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(64)(x)
+        x = batch_norm(train)(x)
+        x = nn.relu(x)
+        for stage, (features, n) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
+            for i in range(n):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = self.block(features=features, stride=stride)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("resnet18")
+def ResNet18(num_classes: int = 10) -> nn.Module:
+    return ResNetModule(BasicBlock, (2, 2, 2, 2), num_classes)
+
+
+@register("resnet34")
+def ResNet34(num_classes: int = 10) -> nn.Module:
+    return ResNetModule(BasicBlock, (3, 4, 6, 3), num_classes)
+
+
+@register("resnet50")
+def ResNet50(num_classes: int = 10) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 4, 6, 3), num_classes)
+
+
+@register("resnet101")
+def ResNet101(num_classes: int = 10) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 4, 23, 3), num_classes)
+
+
+@register("resnet152")
+def ResNet152(num_classes: int = 10) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 8, 36, 3), num_classes)

@@ -1,0 +1,33 @@
+"""Small CNN — the BASELINE.md config-2/3 model ("small CNN on CIFAR-10").
+
+Two conv+pool stages and a two-layer dense head; no BatchNorm, so it is also
+the simplest all-weights FedAvg target.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedtpu.models.common import max_pool
+from fedtpu.models.registry import register
+
+
+class SmallCNNModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), padding=1)(x)
+        x = nn.relu(x)
+        x = max_pool(x, 2)
+        x = nn.Conv(64, (3, 3), padding=1)(x)
+        x = nn.relu(x)
+        x = max_pool(x, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("smallcnn")
+def SmallCNN(num_classes: int = 10) -> nn.Module:
+    return SmallCNNModule(num_classes=num_classes)

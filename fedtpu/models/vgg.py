@@ -1,0 +1,51 @@
+"""VGG for CIFAR (parity: reference ``src/models/vgg.py``).
+
+Conv3x3+BN+ReLU stacks per the VGG11/13/16/19 configs with 2x2 max-pools,
+then a single dense head (the CIFAR variant has no 4096-wide FC layers).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv3x3, max_pool
+from fedtpu.models.registry import register
+
+_CFGS = {
+    "VGG11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"),
+    "VGG19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGGModule(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for entry in self.cfg:
+            if entry == "M":
+                x = max_pool(x, 2)
+            else:
+                x = conv3x3(entry)(x)
+                x = batch_norm(train)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+def VGG(name: str = "VGG19", num_classes: int = 10) -> nn.Module:
+    return VGGModule(cfg=_CFGS[name], num_classes=num_classes)
+
+
+for _name in _CFGS:
+    register(_name)(
+        lambda num_classes=10, _n=_name: VGG(_n, num_classes=num_classes)
+    )
+register("vgg")(lambda num_classes=10: VGG("VGG19", num_classes=num_classes))

@@ -1,0 +1,15 @@
+from fedtpu.parallel.mesh import client_mesh, client_sharded, replicated
+from fedtpu.parallel.sharded import (
+    make_sharded_round_step,
+    shard_batch,
+    shard_state,
+)
+
+__all__ = [
+    "client_mesh",
+    "client_sharded",
+    "replicated",
+    "make_sharded_round_step",
+    "shard_batch",
+    "shard_state",
+]

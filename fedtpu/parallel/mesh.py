@@ -1,0 +1,41 @@
+"""Device mesh construction.
+
+The reference's "topology" is a hub-and-spoke of gRPC channels over TCP
+(``src/server.py:109-111,281-282``). The TPU-native topology is a
+``jax.sharding.Mesh``: one logical ``clients`` axis over all chips (pure
+federated data parallelism — §2d of SURVEY.md), with room for extra axes
+(``model``) if a future model is too large for one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def client_mesh(
+    num_devices: Optional[int] = None,
+    axis_name: str = "clients",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D mesh mapping the federated clients axis across chips.
+
+    On multi-host TPU slices ``jax.devices()`` already spans hosts, so the
+    same mesh scales from 1 chip to a pod; the collectives XLA inserts for the
+    psum-FedAvg ride ICI (and DCN between slices) automatically.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def client_sharded(mesh: Mesh, axis_name: str = "clients") -> NamedSharding:
+    return NamedSharding(mesh, P(axis_name))

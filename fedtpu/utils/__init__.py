@@ -1,0 +1,4 @@
+from fedtpu.utils import trees
+from fedtpu.utils.metrics import MetricsLogger, format_time
+
+__all__ = ["trees", "MetricsLogger", "format_time"]

@@ -1,0 +1,79 @@
+"""Structured metrics + logging.
+
+Replaces the reference's tty-bound progress bar (``src/utils.py:51-92`` — which
+reads the terminal width via ``stty size`` at import time and therefore breaks
+headless runs) with a headless-safe structured logger, and keeps a
+``format_time`` pretty-printer for parity (``src/utils.py:94-124``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("fedtpu")
+
+
+def format_time(seconds: float) -> str:
+    """Human-readable duration, e.g. ``1h23m45s`` (parity: src/utils.py:94-124)."""
+    seconds = float(seconds)
+    days, seconds = divmod(seconds, 86400)
+    hours, seconds = divmod(seconds, 3600)
+    minutes, seconds = divmod(seconds, 60)
+    secs = int(seconds)
+    millis = int((seconds - secs) * 1000)
+
+    parts = []
+    if days >= 1:
+        parts.append(f"{int(days)}D")
+    if hours >= 1 or parts:
+        parts.append(f"{int(hours)}h")
+    if minutes >= 1 or parts:
+        parts.append(f"{int(minutes)}m")
+    parts.append(f"{secs}s")
+    if not parts[:-1] and secs == 0:
+        return f"{millis}ms"
+    return "".join(parts[:3])
+
+
+class MetricsLogger:
+    """Round-level metrics sink: JSONL file and/or stderr lines.
+
+    Replaces the reference's print-based observability
+    (``src/server.py:121,130,148``) with structured records the driver or a
+    dashboard can consume.
+    """
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self._path = path
+        self._echo = echo
+        self._fh = open(path, "a") if path else None
+        self._t0 = time.time()
+
+    def log(self, step: int, **metrics: Any) -> None:
+        rec: Dict[str, Any] = {"step": int(step), "t": round(time.time() - self._t0, 4)}
+        for k, v in metrics.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        line = json.dumps(rec)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self._echo:
+            print(line, file=sys.stderr)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

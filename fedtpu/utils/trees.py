@@ -1,0 +1,72 @@
+"""Pytree arithmetic helpers.
+
+The reference does its parameter arithmetic key-by-key over torch state_dicts
+on the host (reference: ``src/server.py:163-171``). Here the equivalents are
+traceable pytree maps that stay on-device and fuse into the surrounding XLA
+program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a: Pytree, s) -> Pytree:
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_dot(a: Pytree, b: Pytree):
+    leaves = jax.tree.leaves(jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b))
+    return sum(leaves, start=jnp.zeros((), dtype=jnp.float32))
+
+
+def tree_sq_norm(a: Pytree):
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda x: jnp.sum(jnp.square(x.astype(jnp.float32))), a)
+    )
+    return sum(leaves, start=jnp.zeros((), dtype=jnp.float32))
+
+
+def tree_norm(a: Pytree):
+    return jnp.sqrt(tree_sq_norm(a))
+
+
+def tree_size(a: Pytree) -> int:
+    """Total number of scalar parameters (static)."""
+    return sum(int(x.size) for x in jax.tree.leaves(a))
+
+
+def tree_cast(a: Pytree, dtype) -> Pytree:
+    return jax.tree.map(lambda x: x.astype(dtype), a)
+
+
+def tree_ravel(a: Pytree):
+    """Flatten a pytree to a single 1-D vector plus an unravel closure."""
+    return jax.flatten_util.ravel_pytree(a)
+
+
+def tree_stack(trees):
+    """Stack a list of identically-structured pytrees along a new axis 0."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_index(stacked: Pytree, i) -> Pytree:
+    """Select slot ``i`` along the leading axis of every leaf."""
+    return jax.tree.map(lambda x: x[i], stacked)

@@ -1,0 +1,221 @@
+"""Core round-step semantics.
+
+Property tests from SURVEY.md §4: our FedAvg equals a NumPy oracle over
+client states; dead clients are excluded; momentum persists across rounds;
+FedProx's proximal term shrinks local drift.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu import models
+from fedtpu.core import round as round_lib
+from fedtpu.core.client import make_local_update
+from fedtpu.utils import trees
+
+
+def tiny_cfg(**fed_kwargs) -> RoundConfig:
+    return RoundConfig(
+        model="mlp",
+        num_classes=4,
+        opt=OptimizerConfig(learning_rate=0.05, momentum=0.9, weight_decay=0.0),
+        data=DataConfig(dataset="synthetic", batch_size=8),
+        fed=FedConfig(num_clients=4, **fed_kwargs),
+        steps_per_round=3,
+    )
+
+
+def make_batch(cfg, seed=0, alive=None, dim=6):
+    rng = np.random.default_rng(seed)
+    n, s, b = cfg.fed.num_clients, cfg.steps_per_round, cfg.data.batch_size
+    x = rng.normal(size=(n, s, b, dim)).astype(np.float32)
+    y = rng.integers(0, cfg.num_classes, size=(n, s, b)).astype(np.int32)
+    return round_lib.RoundBatch(
+        x=jnp.asarray(x),
+        y=jnp.asarray(y),
+        step_mask=jnp.ones((n, s), bool),
+        weights=jnp.ones((n,), jnp.float32),
+        alive=jnp.ones((n,), bool) if alive is None else jnp.asarray(alive),
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    state = round_lib.init_state(
+        model, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32)
+    )
+    step = jax.jit(round_lib.make_round_step(model, cfg))
+    local = make_local_update(model.apply, cfg)
+    return cfg, model, state, step, local
+
+
+def test_aggregate_matches_numpy_oracle(setup):
+    """Global update == numpy mean of per-client locally-trained params."""
+    cfg, model, state, step, local = setup
+    batch = make_batch(cfg)
+
+    # Run each client's local update independently (the oracle path).
+    n = cfg.fed.num_clients
+    rngs = jax.vmap(jax.random.fold_in)(
+        state.client_rng, jnp.zeros((n,), jnp.int32)
+    )
+    client_params = []
+    for c in range(n):
+        out = local(
+            state.params,
+            state.batch_stats,
+            jax.tree.map(lambda x: x[c], state.opt_state),
+            batch.x[c],
+            batch.y[c],
+            batch.step_mask[c],
+            rngs[c],
+            state.round_idx,
+        )
+        client_params.append(out.params)
+
+    expected = jax.tree.map(
+        lambda *xs: np.mean(np.stack([np.asarray(x) for x in xs]), axis=0),
+        *client_params,
+    )
+    new_state, _ = step(state, batch)
+    for e, g in zip(jax.tree.leaves(expected), jax.tree.leaves(new_state.params)):
+        np.testing.assert_allclose(e, np.asarray(g), rtol=2e-4, atol=2e-5)
+
+
+def test_dead_clients_excluded(setup):
+    """A dead client contributes nothing — unlike the reference, which
+    averages dead clients' stale checkpoint files (src/server.py:157-161)."""
+    cfg, model, state, step, local = setup
+    full = make_batch(cfg, seed=1)
+
+    # Kill client 3; surviving clients' data unchanged.
+    dead = round_lib.RoundBatch(
+        x=full.x,
+        y=full.y,
+        step_mask=full.step_mask,
+        weights=full.weights,
+        alive=jnp.asarray([True, True, True, False]),
+    )
+    s_dead, m_dead = step(state, dead)
+    assert float(m_dead.num_active) == 3.0
+
+    # Oracle: mean over the three living clients only.
+    n = cfg.fed.num_clients
+    rngs = jax.vmap(jax.random.fold_in)(
+        state.client_rng, jnp.zeros((n,), jnp.int32)
+    )
+    survivors = []
+    for c in range(3):
+        out = local(
+            state.params,
+            state.batch_stats,
+            jax.tree.map(lambda x: x[c], state.opt_state),
+            full.x[c], full.y[c], full.step_mask[c], rngs[c], state.round_idx,
+        )
+        survivors.append(out.params)
+    expected = jax.tree.map(
+        lambda *xs: np.mean(np.stack([np.asarray(x) for x in xs]), axis=0), *survivors
+    )
+    for e, g in zip(jax.tree.leaves(expected), jax.tree.leaves(s_dead.params)):
+        np.testing.assert_allclose(e, np.asarray(g), rtol=2e-4, atol=2e-5)
+
+
+def test_all_dead_leaves_model_unchanged(setup):
+    cfg, model, state, step, _ = setup
+    batch = make_batch(cfg, seed=2, alive=np.zeros(4, bool))
+    new_state, metrics = step(state, batch)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(new_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_momentum_persists_across_rounds(setup):
+    """Reference semantics: weights reload from global each round but the
+    torch optimizer (momentum) lives on in the client process
+    (src/main.py:99,130-134)."""
+    cfg, model, state, step, _ = setup
+    b0 = make_batch(cfg, seed=3)
+    s1, _ = step(state, b0)
+    # After one round momentum buffers must be nonzero and carried forward.
+    mom = jax.tree.leaves(s1.opt_state.momentum)
+    assert any(float(jnp.abs(m).max()) > 0 for m in mom)
+    assert int(s1.round_idx) == 1
+
+
+def test_weighted_vs_uniform_differ(setup):
+    cfg, model, state, step, _ = setup
+    batch = make_batch(cfg, seed=4)
+    uneven = round_lib.RoundBatch(
+        x=batch.x, y=batch.y, step_mask=batch.step_mask,
+        weights=jnp.asarray([10.0, 1.0, 1.0, 1.0]), alive=batch.alive,
+    )
+    s_w, _ = step(state, uneven)
+
+    cfg_u = dataclasses.replace(cfg, fed=dataclasses.replace(cfg.fed, weighted=False))
+    step_u = jax.jit(round_lib.make_round_step(model, cfg_u))
+    s_u, _ = step_u(state, uneven)
+    diffs = [
+        float(jnp.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(s_w.params), jax.tree.leaves(s_u.params))
+    ]
+    assert max(diffs) > 1e-6
+
+
+def test_fedprox_reduces_drift():
+    """With a large mu the locally-trained params stay closer to global."""
+    drifts = {}
+    for mu in (0.0, 10.0):
+        cfg = tiny_cfg(algorithm="fedprox", fedprox_mu=mu)
+        model = models.create(cfg.model, num_classes=cfg.num_classes)
+        state = round_lib.init_state(
+            model, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32)
+        )
+        local = make_local_update(model.apply, cfg)
+        batch = make_batch(cfg, seed=5)
+        out = local(
+            state.params, state.batch_stats,
+            jax.tree.map(lambda x: x[0], state.opt_state),
+            batch.x[0], batch.y[0], batch.step_mask[0],
+            jax.random.PRNGKey(7), state.round_idx,
+        )
+        drifts[mu] = float(
+            trees.tree_norm(trees.tree_sub(out.params, state.params))
+        )
+    assert drifts[10.0] < drifts[0.0]
+
+
+def test_masked_steps_are_noops(setup):
+    """Padding steps must not change params (static-shape ragged shards)."""
+    cfg, model, state, step, local = setup
+    batch = make_batch(cfg, seed=6)
+    sm = np.ones((cfg.fed.num_clients, cfg.steps_per_round), bool)
+    sm[:, -1] = False
+    masked = round_lib.RoundBatch(
+        x=batch.x, y=batch.y, step_mask=jnp.asarray(sm),
+        weights=batch.weights, alive=batch.alive,
+    )
+    # Oracle: run with one fewer real step by zeroing the last step's data —
+    # results must match running with the mask.
+    out_masked = local(
+        state.params, state.batch_stats,
+        jax.tree.map(lambda x: x[0], state.opt_state),
+        masked.x[0], masked.y[0], masked.step_mask[0],
+        jax.random.PRNGKey(9), state.round_idx,
+    )
+    out_short = local(
+        state.params, state.batch_stats,
+        jax.tree.map(lambda x: x[0], state.opt_state),
+        masked.x[0][:-1], masked.y[0][:-1],
+        jnp.ones((cfg.steps_per_round - 1,), bool),
+        jax.random.PRNGKey(9), state.round_idx,
+    )
+    # Same number of effective steps; params equal.
+    assert float(out_masked.num_steps) == float(out_short.num_steps)
+    for a, b in zip(jax.tree.leaves(out_masked.params), jax.tree.leaves(out_short.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -1,0 +1,71 @@
+"""Partitioner properties: disjoint cover, reference-exact round-robin rule."""
+
+import numpy as np
+import pytest
+
+from fedtpu.data import partition
+
+
+def test_round_robin_matches_reference_rule():
+    # Reference rule (src/main.py:141-144): rank r keeps batch i iff
+    # (i + 1) % world == r — pre-increment, rank 0 takes wraparound batches.
+    n, bs, world = 1280, 128, 4  # 10 batches
+    idx, mask = partition.round_robin(n, world, bs)
+    for r in range(world):
+        own_batches = {int(i) // bs for i in idx[r][mask[r]]}
+        expected = {i for i in range(n // bs) if (i + 1) % world == r}
+        assert own_batches == expected
+
+
+def test_round_robin_disjoint_cover():
+    n, bs, world = 1280, 128, 3
+    idx, mask = partition.round_robin(n, world, bs)
+    all_idx = np.concatenate([idx[c][mask[c]] for c in range(world)])
+    assert len(all_idx) == len(set(all_idx.tolist()))
+    # All full batches covered (remainder dropped by design).
+    assert set(all_idx.tolist()) == set(range((n // bs) * bs))
+
+
+def test_iid_disjoint_cover():
+    idx, mask = partition.iid(1000, 7, seed=3)
+    all_idx = np.concatenate([idx[c][mask[c]] for c in range(7)])
+    assert sorted(all_idx.tolist()) == list(range(1000))
+
+
+def test_dirichlet_cover_and_skew():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, 5000)
+    idx, mask = partition.dirichlet(labels, 8, alpha=0.5, seed=1)
+    all_idx = np.concatenate([idx[c][mask[c]] for c in range(8)])
+    assert sorted(all_idx.tolist()) == list(range(5000))
+    # Low alpha should produce label skew: client label histograms differ.
+    hists = np.stack(
+        [np.bincount(labels[idx[c][mask[c]]], minlength=10) for c in range(8)]
+    )
+    props = hists / hists.sum(1, keepdims=True)
+    assert props.std(axis=0).mean() > 0.02
+
+
+def test_make_client_batches_shapes_and_wraparound():
+    images = np.arange(40, dtype=np.float32).reshape(40, 1)
+    labels = np.arange(40, dtype=np.int32) % 10
+    idx, mask = partition.iid(40, 4, seed=0)
+    x, y, sm = partition.make_client_batches(images, labels, idx, mask, 5, 3)
+    assert x.shape == (4, 3, 5, 1)
+    assert y.shape == (4, 3, 5)
+    assert sm.shape == (4, 3)
+    assert sm.all()  # every client has data
+    # Each client's batches only contain its own examples.
+    for c in range(4):
+        own = set(idx[c][mask[c]].tolist())
+        assert set(int(v) for v in x[c].ravel()) <= own
+
+
+def test_make_client_batches_empty_client_masked():
+    images = np.ones((10, 1), np.float32)
+    labels = np.zeros((10,), np.int32)
+    idx = np.zeros((2, 10), np.int32)
+    mask = np.zeros((2, 10), bool)
+    mask[0, :] = True  # client 1 has nothing
+    x, y, sm = partition.make_client_batches(images, labels, idx, mask, 2, 2)
+    assert sm[0].all() and not sm[1].any()

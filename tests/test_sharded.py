@@ -1,0 +1,127 @@
+"""Mesh-parallel round == single-program round, on an 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu import models
+from fedtpu.core import round as round_lib
+from fedtpu.parallel import (
+    client_mesh,
+    make_sharded_round_step,
+    shard_batch,
+    shard_state,
+)
+
+
+def cfg8():
+    return RoundConfig(
+        model="mlp",
+        num_classes=4,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(dataset="synthetic", batch_size=8),
+        fed=FedConfig(num_clients=8),
+        steps_per_round=2,
+    )
+
+
+def make_batch(cfg, seed=0, alive=None, dim=6):
+    rng = np.random.default_rng(seed)
+    n, s, b = cfg.fed.num_clients, cfg.steps_per_round, cfg.data.batch_size
+    return round_lib.RoundBatch(
+        x=jnp.asarray(rng.normal(size=(n, s, b, dim)).astype(np.float32)),
+        y=jnp.asarray(rng.integers(0, 4, size=(n, s, b)).astype(np.int32)),
+        step_mask=jnp.ones((n, s), bool),
+        weights=jnp.ones((n,), jnp.float32),
+        alive=jnp.ones((n,), bool) if alive is None else jnp.asarray(alive),
+    )
+
+
+@pytest.fixture(scope="module")
+def shared(request):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = cfg8()
+    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    state = round_lib.init_state(
+        model, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32)
+    )
+    mesh = client_mesh(8, cfg.mesh_axis)
+    return cfg, model, state, mesh
+
+
+def test_sharded_matches_single_program(shared):
+    cfg, model, state, mesh = shared
+    batch = make_batch(cfg, seed=0)
+
+    single = jax.jit(round_lib.make_round_step(model, cfg))
+    s_single, m_single = single(state, batch)
+
+    sharded_step = make_sharded_round_step(model, cfg, mesh, donate=False)
+    s_sh, m_sh = sharded_step(
+        shard_state(state, mesh, cfg.mesh_axis),
+        shard_batch(batch, mesh, cfg.mesh_axis),
+    )
+
+    for a, b in zip(jax.tree.leaves(s_single.params), jax.tree.leaves(s_sh.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        float(m_single.loss), float(m_sh.loss), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(m_single.accuracy), float(m_sh.accuracy), rtol=1e-5
+    )
+
+
+def test_sharded_dead_client_mask(shared):
+    cfg, model, state, mesh = shared
+    alive = np.ones(8, bool)
+    alive[5] = False
+    batch = make_batch(cfg, seed=1, alive=alive)
+
+    single = jax.jit(round_lib.make_round_step(model, cfg))
+    s_single, m_single = single(state, batch)
+
+    sharded_step = make_sharded_round_step(model, cfg, mesh, donate=False)
+    s_sh, m_sh = sharded_step(
+        shard_state(state, mesh, cfg.mesh_axis),
+        shard_batch(batch, mesh, cfg.mesh_axis),
+    )
+    assert float(m_sh.num_active) == 7.0
+    for a, b in zip(jax.tree.leaves(s_single.params), jax.tree.leaves(s_sh.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_multiple_clients_per_device(shared):
+    """16 clients on 8 devices — 2 clients per shard."""
+    cfg, model, _, mesh = shared
+    import dataclasses
+
+    cfg16 = dataclasses.replace(cfg, fed=dataclasses.replace(cfg.fed, num_clients=16))
+    state = round_lib.init_state(
+        models.create(cfg16.model, num_classes=cfg16.num_classes),
+        cfg16,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 6), jnp.float32),
+    )
+    batch = make_batch(cfg16, seed=2)
+    single = jax.jit(round_lib.make_round_step(model, cfg16))
+    s_single, _ = single(state, batch)
+    sharded_step = make_sharded_round_step(model, cfg16, mesh, donate=False)
+    s_sh, _ = sharded_step(
+        shard_state(state, mesh, cfg16.mesh_axis),
+        shard_batch(batch, mesh, cfg16.mesh_axis),
+    )
+    for a, b in zip(jax.tree.leaves(s_single.params), jax.tree.leaves(s_sh.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_indivisible_clients_raises(shared):
+    cfg, model, _, mesh = shared
+    import dataclasses
+
+    bad = dataclasses.replace(cfg, fed=dataclasses.replace(cfg.fed, num_clients=9))
+    with pytest.raises(ValueError):
+        make_sharded_round_step(model, bad, mesh)

@@ -10,7 +10,10 @@ tensors) and donated to the device.
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional, Tuple
+from typing import TYPE_CHECKING, Callable, Optional, Tuple
+
+if TYPE_CHECKING:
+    from fedtpu.ops.compression import Compressor
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +49,7 @@ class Federation:
         self,
         cfg: RoundConfig,
         seed: int = 0,
-        compressor: Optional[Callable] = None,
+        compressor: Optional["Compressor"] = None,
         data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ):
         self.cfg = cfg
@@ -58,8 +61,6 @@ class Federation:
                 f"RoundConfig(num_classes={n_classes})"
             )
         if cfg.fed.compression != "none" and compressor is None:
-            # Wired through fedtpu.ops.compression; constructing from the
-            # config string lands with that module.
             from fedtpu.ops.compression import make_compressor
 
             compressor = make_compressor(cfg.fed)
@@ -92,7 +93,7 @@ class Federation:
 
         sample = jnp.zeros((1,) + tuple(images.shape[1:]), jnp.float32)
         self.state: FederatedState = init_state(
-            self.model, cfg, jax.random.PRNGKey(seed), sample
+            self.model, cfg, jax.random.PRNGKey(seed), sample, compressor
         )
         self._round_step = jax.jit(
             make_round_step(self.model, cfg, compressor), donate_argnums=(0,)

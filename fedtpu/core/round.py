@@ -39,6 +39,9 @@ class FederatedState(NamedTuple):
       its torch optimizer alive between StartTrain calls (``src/main.py:99``).
     - ``client_rng``: per-client PRNG keys, ``[clients, 2]`` uint32.
     - ``round_idx``: drives the cosine LR schedule.
+    - ``comp_state``: per-client compressor residuals (error feedback,
+      :mod:`fedtpu.ops.compression`); the empty pytree ``()`` when
+      compression or error feedback is off.
     """
 
     params: Pytree
@@ -46,6 +49,7 @@ class FederatedState(NamedTuple):
     opt_state: optim.SGDState
     client_rng: jnp.ndarray
     round_idx: jnp.ndarray
+    comp_state: Pytree = ()
 
 
 class RoundMetrics(NamedTuple):
@@ -78,8 +82,11 @@ def init_state(
     cfg: RoundConfig,
     rng: jax.Array,
     sample_input: jnp.ndarray,
+    compressor=None,
 ) -> FederatedState:
-    """Initialise global model + per-client state."""
+    """Initialise global model + per-client state. ``compressor`` (a
+    :class:`fedtpu.ops.compression.Compressor`) seeds error-feedback
+    residuals when given."""
     init_rng, client_rng = jax.random.split(rng)
     variables = model.init(init_rng, sample_input, train=False)
     params = variables["params"]
@@ -96,6 +103,7 @@ def init_state(
         opt_state=opt_state,
         client_rng=jax.random.split(client_rng, n),
         round_idx=jnp.zeros((), jnp.int32),
+        comp_state=() if compressor is None else compressor.init(params, n),
     )
 
 
@@ -131,7 +139,7 @@ def _mean_over_clients(stacked: Pytree, weights: jnp.ndarray, axis_name):
 def make_round_step(
     model: nn.Module,
     cfg: RoundConfig,
-    compressor: Optional[Callable[[Pytree, Pytree], Pytree]] = None,
+    compressor=None,  # Optional[fedtpu.ops.compression.Compressor]
     axis_name: Optional[str] = None,
 ) -> Callable[[FederatedState, RoundBatch], Tuple[FederatedState, RoundMetrics]]:
     """Build the round step.
@@ -141,8 +149,9 @@ def make_round_step(
     (see :mod:`fedtpu.parallel.sharded`): the vmap then runs over the local
     slice of clients and aggregation becomes ``psum`` collectives.
 
-    ``compressor``, when given, maps stacked per-client deltas to compressed
-    deltas — the ``-c Y`` parity path (:mod:`fedtpu.ops.compression`).
+    ``compressor``, when given, is a stateful delta codec
+    (:class:`fedtpu.ops.compression.Compressor`) — the ``-c Y`` parity path;
+    its error-feedback residuals ride in ``state.comp_state``.
     """
     local_update = make_local_update(model.apply, cfg)
     vmapped = jax.vmap(
@@ -184,8 +193,23 @@ def make_round_step(
         deltas = jax.tree.map(
             lambda c, g: c - g[None], out.params, state.params
         )
+        comp_state = state.comp_state
         if compressor is not None:
-            deltas = compressor(deltas, agg_w)
+            deltas, new_comp = compressor.apply(deltas, comp_state)
+            # Dead / non-sampled clients contribute nothing this round (agg_w
+            # is 0), so their residuals must not be drained either — keep the
+            # old residual so the correction is carried until they rejoin.
+            if jax.tree_util.tree_leaves(comp_state):
+                keep = batch.alive
+                comp_state = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        keep.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                    ),
+                    new_comp,
+                    comp_state,
+                )
+            else:
+                comp_state = new_comp
         mean_delta, _ = _mean_over_clients(deltas, agg_w, axis_name)
         new_params = trees.tree_add(state.params, mean_delta)
 
@@ -220,6 +244,7 @@ def make_round_step(
             opt_state=out.opt_state,
             client_rng=state.client_rng,
             round_idx=state.round_idx + 1,
+            comp_state=comp_state,
         )
         return new_state, metrics
 

@@ -1,0 +1,22 @@
+"""On-device ops: update compression codecs + the Pallas kernels behind them.
+
+The TPU-native replacement for the reference's transport-level gzip
+(``-c Y``, reference ``src/server.py:104-107``): deltas are sparsified or
+quantized on-device before aggregation (see :mod:`fedtpu.ops.compression`).
+"""
+
+from fedtpu.ops.compression import (
+    Compressor,
+    make_compressor,
+    make_int8,
+    make_topk,
+    nnz_fraction,
+)
+
+__all__ = [
+    "Compressor",
+    "make_compressor",
+    "make_int8",
+    "make_topk",
+    "nnz_fraction",
+]

@@ -1,0 +1,168 @@
+"""On-device update compression — the TPU-native form of ``-c Y``.
+
+The reference's compression is transport-level gzip on base64-pickled
+checkpoints (``src/server.py:104-107``, ``src/client.py:39-43``): lossless,
+host-side, and applied *after* a 33% base64 inflation. fedtpu compresses where
+it actually pays on TPU: client *deltas* are sparsified/quantized on-device
+*before* aggregation, so
+
+- the FedAvg collective moves fewer effective bytes over ICI/DCN,
+- the DCN edge transport (:mod:`fedtpu.transport`) can ship the compact form
+  (top-k indices+values or int8 codes) instead of dense f32,
+- error feedback keeps convergence: what a round drops is carried into the
+  next round's delta (residual state per client, living alongside momentum in
+  :class:`fedtpu.core.round.FederatedState`).
+
+Codecs:
+- ``topk``  — per-leaf, per-client magnitude top-k (fraction ``topk_fraction``).
+- ``int8``  — per-leaf, per-client symmetric int8 quantization.
+
+Both run through the fused Pallas kernels in
+:mod:`fedtpu.ops.pallas_kernels`; both are simulated on-device (compress →
+decompress) so aggregation sees exactly the numbers the wire format would
+carry.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtpu.config import FedConfig
+from fedtpu.ops import pallas_kernels as pk
+
+Pytree = Any
+
+
+class Compressor(NamedTuple):
+    """A stateful delta codec.
+
+    ``init(params, num_clients)`` builds the per-client residual state (an
+    empty dict when error feedback is off). ``apply(deltas, state)`` maps
+    stacked per-client deltas ``[clients, ...]`` to (compressed deltas, new
+    state). ``apply`` is pure and jit/shard_map-safe; under ``shard_map`` the
+    clients axis of both deltas and state is the sharded axis.
+    """
+
+    init: Callable[[Pytree, int], Pytree]
+    apply: Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]
+
+
+def _flatten_leaf(d: jnp.ndarray) -> jnp.ndarray:
+    """[clients, ...] -> [clients, size] float32."""
+    return d.reshape((d.shape[0], -1)).astype(jnp.float32)
+
+
+def _make_init(error_feedback: bool) -> Callable[[Pytree, int], Pytree]:
+    """Residual-state initialiser: per-client zeros shaped like the stacked
+    params when error feedback is on; the empty pytree ``()`` otherwise (the
+    same sentinel :class:`fedtpu.core.round.FederatedState` defaults to)."""
+
+    def init(params: Pytree, num_clients: int) -> Pytree:
+        if not error_feedback:
+            return ()
+        return jax.tree.map(
+            lambda p: jnp.zeros((num_clients,) + p.shape, jnp.float32), params
+        )
+
+    return init
+
+
+def _make_apply(
+    leaf: Callable[[jnp.ndarray, Optional[jnp.ndarray]], Tuple[jnp.ndarray, jnp.ndarray]],
+    error_feedback: bool,
+) -> Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]:
+    """Lift a per-leaf ``(delta, residual) -> (compressed, new_residual)``
+    codec to pytrees, handling the no-error-feedback case (empty state)."""
+
+    def apply(deltas: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
+        if error_feedback:
+            pairs = jax.tree.map(leaf, deltas, state)
+        else:
+            pairs = jax.tree.map(lambda d: leaf(d, None), deltas)
+        is_pair = lambda x: isinstance(x, tuple) and not isinstance(x, jnp.ndarray)
+        out = jax.tree.map(lambda p: p[0], pairs, is_leaf=is_pair)
+        if not error_feedback:
+            return out, state
+        new_state = jax.tree.map(lambda p: p[1], pairs, is_leaf=is_pair)
+        return out, new_state
+
+    return apply
+
+
+def make_topk(fraction: float, error_feedback: bool = True) -> Compressor:
+    """Magnitude top-k sparsification with optional error feedback.
+
+    Per leaf, per client: keep the ``ceil(fraction * size)`` largest-|.|
+    entries of (delta + residual), zero the rest, carry the dropped mass as
+    the next round's residual. Ties at the threshold may keep a few extra
+    entries (threshold comparison is ``>=``) — harmless for convergence and
+    it keeps the kernel a pure elementwise mask.
+    """
+
+    def leaf(d: jnp.ndarray, e: Optional[jnp.ndarray]):
+        shape = d.shape
+        y = _flatten_leaf(d)
+        if e is not None:
+            y = y + e.reshape(y.shape)
+        size = y.shape[1]
+        k = max(1, int(math.ceil(fraction * size)))
+        if k >= size:
+            return y.reshape(shape).astype(d.dtype), jnp.zeros(shape, jnp.float32)
+        # k-th largest magnitude per client row is the keep threshold.
+        kth = jax.lax.top_k(jnp.abs(y), k)[0][:, -1]
+        if e is None:
+            # No residual output wanted: a plain masked select, which XLA
+            # fuses; the two-output kernel would force a dead full-size write.
+            out = jnp.where(jnp.abs(y) >= kth[:, None], y, 0.0)
+            return out.reshape(shape).astype(d.dtype), None
+        out, new_e = pk.threshold_with_feedback(y, kth)
+        return out.reshape(shape).astype(d.dtype), new_e.reshape(shape)
+
+    return Compressor(init=_make_init(error_feedback), apply=_make_apply(leaf, error_feedback))
+
+
+def make_int8(error_feedback: bool = True) -> Compressor:
+    """Symmetric per-leaf int8 quantization with optional error feedback.
+
+    scale = max|delta + residual| / 127 per client per leaf; wire format is
+    int8 codes + one f32 scale (4096x smaller metadata than the values).
+    On-device we simulate quantize→dequantize so FedAvg averages the exact
+    wire numbers.
+    """
+
+    def leaf(d: jnp.ndarray, e: Optional[jnp.ndarray]):
+        shape = d.shape
+        y = _flatten_leaf(d)
+        if e is not None:
+            y = y + e.reshape(y.shape)
+        scale = jnp.max(jnp.abs(y), axis=1) / 127.0
+        out = pk.quantdequant_int8(y, scale)
+        new_e = None if e is None else (y - out).reshape(shape)
+        return out.reshape(shape).astype(d.dtype), new_e
+
+    return Compressor(init=_make_init(error_feedback), apply=_make_apply(leaf, error_feedback))
+
+
+def make_compressor(fed: FedConfig) -> Optional[Compressor]:
+    """Compressor from config (``FedConfig.compression``); None for 'none'."""
+    if fed.compression == "none":
+        return None
+    if fed.compression == "topk":
+        return make_topk(fed.topk_fraction, fed.error_feedback)
+    if fed.compression == "int8":
+        return make_int8(fed.error_feedback)
+    raise ValueError(f"unknown compression '{fed.compression}'")
+
+
+def nnz_fraction(deltas: Pytree) -> jnp.ndarray:
+    """Fraction of nonzero entries across a (compressed) delta pytree — an
+    effective-wire-size diagnostic (used by tests and the transport edge;
+    not currently part of RoundMetrics)."""
+    leaves = jax.tree_util.tree_leaves(deltas)
+    nnz = sum(jnp.sum(l != 0).astype(jnp.float32) for l in leaves)
+    total = sum(l.size for l in leaves)
+    return nnz / max(total, 1)

@@ -1,0 +1,111 @@
+"""Pallas TPU kernels for the update-compression hot path.
+
+The compression pipeline (threshold mask, residual split, quantize — see
+:mod:`fedtpu.ops.compression`) is a chain of elementwise ops over every
+parameter of every client: at 64 clients x ~3.2M params (MobileNet, reference
+``src/models/mobilenet.py``) that is ~800 MB of traffic per round if each op
+round-trips HBM. XLA fuses most of the chain already; the Pallas kernels below
+pin the fusion explicitly — one read of the combined delta+residual, one write
+of (compressed, new_residual) — so the compression path stays
+bandwidth-minimal regardless of what the surrounding program does to XLA's
+fusion decisions.
+
+Kernels run in interpret mode off-TPU so the same code path is exercised by
+the CPU test suite (see ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Column-block size in elements: 256K f32 = 1 MB per operand per grid step —
+# large enough that grid dispatch is negligible, small enough that the 4-5
+# operands of a step stay well inside the ~16 MB of VMEM.
+_BLOCK = 256 * 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _threshold_kernel(y_ref, t_ref, out_ref, new_e_ref):
+    """One tile of fused magnitude threshold + residual split.
+
+    keep = |y| >= t (per-client threshold); out = y * keep; new_e = y - out.
+    The caller precomputes y = delta + residual (it needs y anyway for the
+    top-k threshold), so the kernel reads ONE full-size operand.
+    """
+    y = y_ref[...]
+    keep = jnp.abs(y) >= t_ref[0]
+    out = jnp.where(keep, y, jnp.zeros_like(y))
+    out_ref[...] = out
+    new_e_ref[...] = y - out
+
+
+@functools.partial(jax.jit, static_argnames=())
+def threshold_with_feedback(y: jnp.ndarray, thresh: jnp.ndarray):
+    """Fused ``out = y * (|y| >= thresh); new_e = y - out``.
+
+    ``y: [rows, cols]`` (rows = clients, cols = leaf size; the caller's
+    delta + residual), ``thresh: [rows]`` per-row magnitude threshold.
+    Returns ``(out, new_e)``.
+    """
+    rows, cols = y.shape
+    col_block = min(cols, _BLOCK)
+    # Grid: one client row per step, columns tiled in ~1 MB blocks.
+    grid = (rows, pl.cdiv(cols, col_block))
+    return pl.pallas_call(
+        _threshold_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+            pl.BlockSpec((1,), lambda r, c: (r,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(y.shape, y.dtype),
+            jax.ShapeDtypeStruct(y.shape, y.dtype),
+        ],
+        interpret=_interpret(),
+    )(y, thresh)
+
+
+def _quantdequant_kernel(x_ref, s_ref, out_ref):
+    """One tile of simulated int8 quantize-dequantize: round(x/s) * s."""
+    s = s_ref[0]
+    # Guard the all-zero leaf: scale 0 would produce NaN via 0/0.
+    safe = jnp.where(s > 0, s, jnp.ones_like(s))
+    q = jnp.clip(jnp.round(x_ref[...] / safe), -127.0, 127.0)
+    out_ref[...] = q * safe
+
+
+@functools.partial(jax.jit, static_argnames=())
+def quantdequant_int8(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Simulated symmetric int8 codec: ``clip(round(x/scale), ±127) * scale``.
+
+    ``x: [rows, cols]``, ``scale: [rows]`` (per-client max|x|/127). The wire
+    format for the DCN edge transmits the int8 codes + one f32 scale per leaf
+    (:mod:`fedtpu.transport.codec`); on-device FedAvg uses this fused
+    quantize-dequantize so aggregation sees exactly the wire numbers.
+    """
+    rows, cols = x.shape
+    col_block = min(cols, _BLOCK)
+    grid = (rows, pl.cdiv(cols, col_block))
+    return pl.pallas_call(
+        _quantdequant_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+            pl.BlockSpec((1,), lambda r, c: (r,)),
+        ],
+        out_specs=pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x, scale)

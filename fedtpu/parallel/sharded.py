@@ -36,6 +36,7 @@ def state_specs(axis: str) -> FederatedState:
         opt_state=P(axis),
         client_rng=P(axis),
         round_idx=P(),
+        comp_state=P(axis),
     )
 
 
@@ -49,7 +50,7 @@ def make_sharded_round_step(
     model: nn.Module,
     cfg: RoundConfig,
     mesh: Mesh,
-    compressor: Optional[Callable] = None,
+    compressor=None,  # Optional[fedtpu.ops.compression.Compressor]
     donate: bool = True,
 ) -> Callable[[FederatedState, RoundBatch], Tuple[FederatedState, RoundMetrics]]:
     """Jitted round step over a client mesh.
@@ -92,6 +93,7 @@ def shard_state(state: FederatedState, mesh: Mesh, axis: str) -> FederatedState:
         opt_state=jax.tree.map(lambda x: put(x, P(axis)), state.opt_state),
         client_rng=put(state.client_rng, P(axis)),
         round_idx=put(state.round_idx, P()),
+        comp_state=jax.tree.map(lambda x: put(x, P(axis)), state.comp_state),
     )
 
 

@@ -1,0 +1,205 @@
+"""Update-compression codecs (fedtpu.ops) — the ``-c Y`` parity path.
+
+Covers: top-k sparsity level, int8 quantization error bound, the
+mass-conservation property of error feedback (compressed + residual ==
+input + previous residual), the Pallas kernels vs a plain-jnp oracle, and a
+full round step running with compression enabled (residuals carried in
+FederatedState.comp_state).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu import models
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core import round as round_lib
+from fedtpu.ops import compression, pallas_kernels as pk
+
+
+def tree_of_deltas(rng, n=4):
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 16, 32)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(n, 32)).astype(np.float32)),
+    }
+
+
+# --------------------------------------------------------------- pallas units
+def test_threshold_kernel_matches_oracle(rng):
+    y = jnp.asarray(rng.normal(size=(3, 1000)).astype(np.float32))
+    t = jnp.asarray([0.5, 1.0, 2.0], jnp.float32)
+    out, new_e = pk.threshold_with_feedback(y, t)
+    yn = np.asarray(y)
+    keep = np.abs(yn) >= np.asarray(t)[:, None]
+    np.testing.assert_allclose(np.asarray(out), yn * keep, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_e), yn * ~keep, atol=1e-6)
+
+
+def test_quantdequant_kernel_matches_oracle(rng):
+    x = jnp.asarray(rng.normal(size=(2, 513)).astype(np.float32))
+    scale = jnp.max(jnp.abs(x), axis=1) / 127.0
+    out = pk.quantdequant_int8(x, scale)
+    s = np.asarray(scale)[:, None]
+    expected = np.clip(np.round(np.asarray(x) / s), -127, 127) * s
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-6)
+
+
+def test_quantdequant_zero_leaf_is_safe():
+    x = jnp.zeros((2, 64), jnp.float32)
+    out = pk.quantdequant_int8(x, jnp.zeros((2,), jnp.float32))
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+# -------------------------------------------------------------------- codecs
+def test_topk_sparsity_level(rng):
+    deltas = tree_of_deltas(rng)
+    comp = compression.make_topk(fraction=0.1, error_feedback=False)
+    out, _ = comp.apply(deltas, {})
+    frac = float(compression.nnz_fraction(out))
+    # >= because ties keep extras; <= 2x because random gaussians rarely tie.
+    assert 0.05 <= frac <= 0.2
+    # Every kept entry must be at least as large as every dropped entry, per
+    # client per leaf.
+    for name in ("w", "b"):
+        o = np.asarray(out[name]).reshape(4, -1)
+        d = np.asarray(deltas[name]).reshape(4, -1)
+        for c in range(4):
+            kept = np.abs(d[c][o[c] != 0])
+            dropped = np.abs(d[c][o[c] == 0])
+            if len(kept) and len(dropped):
+                assert kept.min() >= dropped.max() - 1e-6
+
+
+def test_error_feedback_mass_conservation(rng):
+    """compressed + new_residual == delta + old_residual, exactly."""
+    deltas = tree_of_deltas(rng)
+    comp = compression.make_topk(fraction=0.05, error_feedback=True)
+    state = comp.init({k: v[0] for k, v in deltas.items()}, 4)
+    # Seed nonzero residuals to exercise the carry.
+    state = jax.tree.map(lambda e: e + 0.01, state)
+    out, new_state = comp.apply(deltas, state)
+    for k in deltas:
+        lhs = np.asarray(out[k]) + np.asarray(new_state[k]).reshape(out[k].shape)
+        rhs = np.asarray(deltas[k]) + 0.01
+        np.testing.assert_allclose(lhs, rhs, atol=1e-5)
+
+
+def test_error_feedback_recovers_dropped_mass(rng):
+    """A constant delta stream through an aggressive top-k: with error
+    feedback the cumulative compressed output tracks the cumulative input
+    (residual stays bounded), so nothing is permanently lost."""
+    comp = compression.make_topk(fraction=0.25, error_feedback=True)
+    delta = {"w": jnp.asarray(rng.normal(size=(2, 64)).astype(np.float32))}
+    state = comp.init({"w": delta["w"][0]}, 2)
+    total_out = jax.tree.map(jnp.zeros_like, delta)
+    rounds = 12
+    for _ in range(rounds):
+        out, state = comp.apply(delta, state)
+        total_out = jax.tree.map(jnp.add, total_out, out)
+    # total_in - total_out == final residual -> relative gap shrinks with T.
+    gap = np.abs(
+        rounds * np.asarray(delta["w"]) - np.asarray(total_out["w"])
+    ).max()
+    per_round = np.abs(np.asarray(delta["w"])).max()
+    assert gap <= 4 * per_round  # residual bounded, not growing with rounds
+
+
+def test_int8_error_bound(rng):
+    deltas = tree_of_deltas(rng)
+    comp = compression.make_int8(error_feedback=False)
+    out, _ = comp.apply(deltas, {})
+    for k in deltas:
+        d = np.asarray(deltas[k]).reshape(4, -1)
+        o = np.asarray(out[k]).reshape(4, -1)
+        scale = np.abs(d).max(axis=1, keepdims=True) / 127.0
+        assert np.all(np.abs(d - o) <= scale / 2 + 1e-7)
+
+
+def test_make_compressor_dispatch():
+    assert compression.make_compressor(FedConfig(compression="none")) is None
+    assert compression.make_compressor(FedConfig(compression="topk")) is not None
+    assert compression.make_compressor(FedConfig(compression="int8")) is not None
+    with pytest.raises(ValueError):
+        compression.make_compressor(FedConfig(compression="huffman"))
+
+
+# -------------------------------------------------- end-to-end in round_step
+def _round_setup(compression_kind):
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=4,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(dataset="synthetic", batch_size=8),
+        fed=FedConfig(num_clients=4, compression=compression_kind,
+                      topk_fraction=0.1),
+        steps_per_round=3,
+    )
+    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    comp = compression.make_compressor(cfg.fed)
+    state = round_lib.init_state(
+        model, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32), comp
+    )
+    step = jax.jit(round_lib.make_round_step(model, cfg, compressor=comp))
+    rng = np.random.default_rng(0)
+    n, s, b = 4, 3, 8
+    batch = round_lib.RoundBatch(
+        x=jnp.asarray(rng.normal(size=(n, s, b, 6)).astype(np.float32)),
+        y=jnp.asarray(rng.integers(0, 4, size=(n, s, b)).astype(np.int32)),
+        step_mask=jnp.ones((n, s), bool),
+        weights=jnp.ones((n,), jnp.float32),
+        alive=jnp.ones((n,), bool),
+    )
+    return cfg, state, step, batch
+
+
+@pytest.mark.parametrize("kind", ["topk", "int8"])
+def test_round_step_with_compression(kind):
+    cfg, state, step, batch = _round_setup(kind)
+    assert jax.tree_util.tree_leaves(state.comp_state)  # residuals allocated
+    s1, m1 = step(state, batch)
+    s2, m2 = step(s1, batch)
+    # Model actually moves, and residuals become nonzero (lossy codec).
+    moved = max(
+        float(jnp.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(s2.params))
+    )
+    assert moved > 0
+    res = max(float(jnp.abs(r).max()) for r in jax.tree.leaves(s2.comp_state))
+    assert res > 0
+    assert np.isfinite(float(m2.loss))
+
+
+def test_dead_client_residual_preserved():
+    """A dead client's error-feedback residual must be carried untouched —
+    its (zeroed) delta contributes nothing, so draining the residual would
+    permanently lose its correction mass."""
+    cfg, state, step, batch = _round_setup("topk")
+    s1, _ = step(state, batch)  # round 0: everyone alive, residuals fill
+    dead = round_lib.RoundBatch(
+        x=batch.x, y=batch.y, step_mask=batch.step_mask,
+        weights=batch.weights,
+        alive=jnp.asarray([True, True, True, False]),
+    )
+    s2, _ = step(s1, dead)
+    for r1, r2 in zip(jax.tree.leaves(s1.comp_state), jax.tree.leaves(s2.comp_state)):
+        # Client 3's residual row unchanged; a living client's moved.
+        np.testing.assert_allclose(np.asarray(r1)[3], np.asarray(r2)[3], atol=0)
+    moved = max(
+        float(jnp.abs(np.asarray(r1)[0] - np.asarray(r2)[0]).max())
+        for r1, r2 in zip(jax.tree.leaves(s1.comp_state), jax.tree.leaves(s2.comp_state))
+    )
+    assert moved > 0
+
+
+def test_compressed_training_still_converges():
+    """Short synthetic run: loss under top-k+EF decreases from round 0."""
+    cfg, state, step, batch = _round_setup("topk")
+    losses = []
+    for _ in range(8):
+        state, m = step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0]

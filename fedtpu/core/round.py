@@ -196,11 +196,12 @@ def make_round_step(
         comp_state = state.comp_state
         if compressor is not None:
             deltas, new_comp = compressor.apply(deltas, comp_state)
-            # Dead / non-sampled clients contribute nothing this round (agg_w
-            # is 0), so their residuals must not be drained either — keep the
-            # old residual so the correction is carried until they rejoin.
+            # Clients contributing nothing this round (agg_w == 0: dead,
+            # non-sampled, or zero-weight) must not have their residuals
+            # drained either — keep the old residual so the correction is
+            # carried until they actually contribute.
             if jax.tree_util.tree_leaves(comp_state):
-                keep = batch.alive
+                keep = agg_w > 0
                 comp_state = jax.tree.map(
                     lambda new, old: jnp.where(
                         keep.reshape((-1,) + (1,) * (new.ndim - 1)), new, old

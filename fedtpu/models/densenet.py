@@ -1,0 +1,89 @@
+"""DenseNet family for CIFAR (parity: reference ``src/models/densenet.py``).
+
+Dense bottleneck layers (BN→ReLU→1x1(4k)→BN→ReLU→3x3(k)) whose outputs are
+concatenated with their input; transition layers (BN→ReLU→1x1 halve → 2x2
+avg-pool) between the four dense stages. Constructors match the reference:
+DenseNet121/169/201/161 and ``densenet_cifar``
+(``src/models/densenet.py:86-99``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import avg_pool, batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.relu(batch_norm(train)(x))
+        y = conv1x1(4 * self.growth_rate)(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv3x3(self.growth_rate)(y)
+        return jnp.concatenate([y, x], axis=-1)
+
+
+class Transition(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(batch_norm(train)(x))
+        x = conv1x1(self.features)(x)
+        return avg_pool(x, 2)
+
+
+class DenseNetModule(nn.Module):
+    num_blocks: Sequence[int]
+    growth_rate: int = 12
+    reduction: float = 0.5
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = self.growth_rate
+        planes = 2 * k
+        x = conv3x3(planes)(x)
+        for stage, n in enumerate(self.num_blocks):
+            for _ in range(n):
+                x = DenseLayer(k)(x, train=train)
+            planes += n * k
+            if stage < len(self.num_blocks) - 1:
+                planes = int(math.floor(planes * self.reduction))
+                x = Transition(planes)(x, train=train)
+        x = nn.relu(batch_norm(train)(x))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("densenet121")
+def DenseNet121(num_classes: int = 10) -> nn.Module:
+    return DenseNetModule((6, 12, 24, 16), growth_rate=32, num_classes=num_classes)
+
+
+@register("densenet169")
+def DenseNet169(num_classes: int = 10) -> nn.Module:
+    return DenseNetModule((6, 12, 32, 32), growth_rate=32, num_classes=num_classes)
+
+
+@register("densenet201")
+def DenseNet201(num_classes: int = 10) -> nn.Module:
+    return DenseNetModule((6, 12, 48, 32), growth_rate=32, num_classes=num_classes)
+
+
+@register("densenet161")
+def DenseNet161(num_classes: int = 10) -> nn.Module:
+    return DenseNetModule((6, 12, 36, 24), growth_rate=48, num_classes=num_classes)
+
+
+@register("densenet_cifar")
+def densenet_cifar(num_classes: int = 10) -> nn.Module:
+    return DenseNetModule((6, 12, 24, 16), growth_rate=12, num_classes=num_classes)

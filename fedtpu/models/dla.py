@@ -1,0 +1,90 @@
+"""DLA for CIFAR (parity: reference ``src/models/dla.py``).
+
+Deep-layer-aggregation trees of residual BasicBlocks. A level-1 tree is
+(left block, right block) joined by a Root (concat → 1x1 conv+BN+ReLU); a
+level-k tree chains a ``prev_root`` block and k-1 subtrees, feeding every
+intermediate into one wide Root — matching the reference's flat-root variant.
+Stages: three conv stems (16, 16, 32) then trees at (64, l1), (128, l2),
+(256, l2), (512, l1) with strides (1, 2, 2, 2).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv3x3(self.features)(y)
+        y = batch_norm(train)(y)
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = conv1x1(self.features, strides=(self.stride, self.stride))(x)
+            shortcut = batch_norm(train)(shortcut)
+        else:
+            shortcut = x
+        return nn.relu(y + shortcut)
+
+
+class Root(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        x = jnp.concatenate(xs, axis=-1)
+        x = conv1x1(self.features)(x)
+        return nn.relu(batch_norm(train)(x))
+
+
+class Tree(nn.Module):
+    features: int
+    level: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        xs = []
+        if self.level > 1:
+            xs.append(BasicBlock(self.features, self.stride)(x, train=train))
+            for lvl in reversed(range(1, self.level)):
+                x = Tree(self.features, level=lvl, stride=self.stride)(
+                    x, train=train
+                )
+                xs.append(x)
+            x = BasicBlock(self.features, 1)(x, train=train)
+        else:
+            x = BasicBlock(self.features, self.stride)(x, train=train)
+        xs.append(x)
+        x = BasicBlock(self.features, 1)(x, train=train)
+        xs.append(x)
+        return Root(self.features)(xs, train=train)
+
+
+class DLAModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for features in (16, 16, 32):
+            x = conv3x3(features)(x)
+            x = nn.relu(batch_norm(train)(x))
+        x = Tree(64, level=1, stride=1)(x, train=train)
+        x = Tree(128, level=2, stride=2)(x, train=train)
+        x = Tree(256, level=2, stride=2)(x, train=train)
+        x = Tree(512, level=1, stride=2)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("dla")
+def DLA(num_classes: int = 10) -> nn.Module:
+    return DLAModule(num_classes=num_classes)

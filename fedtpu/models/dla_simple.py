@@ -1,0 +1,53 @@
+"""Simplified DLA for CIFAR (parity: reference ``src/models/dla_simple.py``).
+
+Binary aggregation trees: each tree is (left subtree at stride s, right
+subtree at stride 1 fed from the left) joined by a two-input Root; level-1
+subtrees are residual BasicBlocks. Same stage plan as :mod:`fedtpu.models.dla`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+from fedtpu.models.dla import BasicBlock, Root
+
+
+class SimpleTree(nn.Module):
+    features: int
+    level: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.level == 1:
+            left = BasicBlock(self.features, self.stride)(x, train=train)
+            right = BasicBlock(self.features, 1)(left, train=train)
+        else:
+            left = SimpleTree(self.features, self.level - 1, self.stride)(
+                x, train=train
+            )
+            right = SimpleTree(self.features, self.level - 1, 1)(left, train=train)
+        return Root(self.features)([left, right], train=train)
+
+
+class SimpleDLAModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for features in (16, 16, 32):
+            x = conv3x3(features)(x)
+            x = nn.relu(batch_norm(train)(x))
+        x = SimpleTree(64, level=1, stride=1)(x, train=train)
+        x = SimpleTree(128, level=2, stride=2)(x, train=train)
+        x = SimpleTree(256, level=2, stride=2)(x, train=train)
+        x = SimpleTree(512, level=1, stride=2)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("simpledla")
+def SimpleDLA(num_classes: int = 10) -> nn.Module:
+    return SimpleDLAModule(num_classes=num_classes)

@@ -1,0 +1,102 @@
+"""Dual Path Networks for CIFAR (parity: reference ``src/models/dpn.py``).
+
+Each bottleneck (1x1 → grouped 3x3 (32 groups) → 1x1) emits
+``out_planes + dense_depth`` channels: the first ``out_planes`` are summed
+with the shortcut (residual path) and the tail is concatenated (dense path),
+so the dense path grows by ``dense_depth`` every block. Constructors match
+the reference: DPN26, DPN92 (``src/models/dpn.py:73-89``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class DualPathBlock(nn.Module):
+    in_planes: int       # bottleneck width
+    out_planes: int      # residual-path width
+    dense_depth: int
+    stride: int = 1
+    first_layer: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.out_planes
+        y = conv1x1(self.in_planes)(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = nn.Conv(
+            self.in_planes,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=32,
+            use_bias=False,
+        )(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv1x1(d + self.dense_depth)(y)
+        y = batch_norm(train)(y)
+        if self.first_layer:
+            shortcut = conv1x1(
+                d + self.dense_depth, strides=(self.stride, self.stride)
+            )(x)
+            shortcut = batch_norm(train)(shortcut)
+        else:
+            shortcut = x
+        out = jnp.concatenate(
+            [shortcut[..., :d] + y[..., :d], shortcut[..., d:], y[..., d:]],
+            axis=-1,
+        )
+        return nn.relu(out)
+
+
+class DPNModule(nn.Module):
+    in_planes: Sequence[int]
+    out_planes: Sequence[int]
+    num_blocks: Sequence[int]
+    dense_depth: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(64)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for stage in range(4):
+            for i in range(self.num_blocks[stage]):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = DualPathBlock(
+                    self.in_planes[stage],
+                    self.out_planes[stage],
+                    self.dense_depth[stage],
+                    stride=stride,
+                    first_layer=i == 0,
+                )(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("dpn26")
+def DPN26(num_classes: int = 10) -> nn.Module:
+    return DPNModule(
+        (96, 192, 384, 768),
+        (256, 512, 1024, 2048),
+        (2, 2, 2, 2),
+        (16, 32, 24, 128),
+        num_classes,
+    )
+
+
+@register("dpn92")
+def DPN92(num_classes: int = 10) -> nn.Module:
+    return DPNModule(
+        (96, 192, 384, 768),
+        (256, 512, 1024, 2048),
+        (3, 4, 20, 3),
+        (16, 32, 24, 128),
+        num_classes,
+    )

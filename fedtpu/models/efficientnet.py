@@ -1,0 +1,118 @@
+"""EfficientNet-B0 for CIFAR (parity: reference ``src/models/efficientnet.py``).
+
+MBConv blocks: 1x1 expand (skipped at expansion 1) → k x k depthwise →
+squeeze-excitation (ratio 0.25 of *input* channels, swish inside) → 1x1 linear
+project, swish activations, identity skip with stochastic depth (drop-connect
+rate ramping linearly over block index). B0 config per the reference table
+(``src/models/efficientnet.py:154-163``); CIFAR stem is 3x3/32 stride 1.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+def swish(x):
+    return x * nn.sigmoid(x)
+
+
+class MBConv(nn.Module):
+    features: int
+    kernel_size: int
+    stride: int
+    expand_ratio: int
+    se_ratio: float = 0.25
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        mid = self.expand_ratio * in_ch
+        y = x
+        if self.expand_ratio != 1:
+            y = conv1x1(mid)(y)
+            y = swish(batch_norm(train)(y))
+        k = self.kernel_size
+        y = nn.Conv(
+            mid,
+            (k, k),
+            strides=(self.stride, self.stride),
+            padding=(k - 1) // 2,
+            feature_group_count=mid,
+            use_bias=False,
+        )(y)
+        y = swish(batch_norm(train)(y))
+        # Squeeze-excitation (biased 1x1 convs, swish then sigmoid).
+        se_ch = int(in_ch * self.se_ratio)
+        w = jnp.mean(y, axis=(1, 2), keepdims=True)
+        w = swish(nn.Conv(se_ch, (1, 1))(w))
+        w = nn.sigmoid(nn.Conv(mid, (1, 1))(w))
+        y = y * w
+        y = conv1x1(self.features)(y)
+        y = batch_norm(train)(y)
+        if self.stride == 1 and in_ch == self.features:
+            if train and self.drop_rate > 0:
+                # Drop-connect (stochastic depth): zero whole samples' residual
+                # branch, rescaled to keep the expectation.
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (y.shape[0], 1, 1, 1))
+                y = jnp.where(mask, y / keep, 0.0)
+            y = y + x
+        return y
+
+
+class EfficientNetModule(nn.Module):
+    num_blocks: Sequence[int]
+    expansion: Sequence[int]
+    out_channels: Sequence[int]
+    kernel_size: Sequence[int]
+    stride: Sequence[int]
+    dropout_rate: float = 0.2
+    drop_connect_rate: float = 0.2
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(32)(x)
+        x = swish(batch_norm(train)(x))
+        b, total = 0, sum(self.num_blocks)
+        for e, out, n, k, s in zip(
+            self.expansion,
+            self.out_channels,
+            self.num_blocks,
+            self.kernel_size,
+            self.stride,
+        ):
+            for i in range(n):
+                x = MBConv(
+                    out,
+                    k,
+                    s if i == 0 else 1,
+                    e,
+                    drop_rate=self.drop_connect_rate * b / total,
+                )(x, train=train)
+                b += 1
+        x = global_avg_pool(x)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("efficientnetb0")
+def EfficientNetB0(num_classes: int = 10) -> nn.Module:
+    return EfficientNetModule(
+        num_blocks=(1, 2, 2, 3, 3, 4, 1),
+        expansion=(1, 6, 6, 6, 6, 6, 6),
+        out_channels=(16, 24, 40, 80, 112, 192, 320),
+        kernel_size=(3, 3, 5, 3, 5, 5, 3),
+        stride=(1, 2, 2, 2, 1, 2, 1),
+        num_classes=num_classes,
+    )

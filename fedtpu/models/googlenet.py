@@ -1,0 +1,84 @@
+"""GoogLeNet / Inception for CIFAR (parity: reference ``src/models/googlenet.py``).
+
+Four-branch Inception modules (1x1 | 1x1→3x3 | 1x1→3x3→3x3 | pool→1x1, all
+conv+BN+ReLU, biased convs as in the reference) concatenated on channels; the
+CIFAR stem is a single 3x3/192 conv. Branch widths follow the reference table
+(``src/models/googlenet.py:60-72``); 8x8 global pool + dense head.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, global_avg_pool, max_pool
+from fedtpu.models.registry import register
+
+
+def _conv_bn_relu(x, features, kernel, train):
+    x = nn.Conv(features, (kernel, kernel), padding=(kernel - 1) // 2)(x)
+    return nn.relu(batch_norm(train)(x))
+
+
+class Inception(nn.Module):
+    n1x1: int
+    n3x3red: int
+    n3x3: int
+    n5x5red: int
+    n5x5: int
+    pool_planes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b1 = _conv_bn_relu(x, self.n1x1, 1, train)
+
+        b2 = _conv_bn_relu(x, self.n3x3red, 1, train)
+        b2 = _conv_bn_relu(b2, self.n3x3, 3, train)
+
+        # The "5x5" branch is two stacked 3x3 convs, as in the reference.
+        b3 = _conv_bn_relu(x, self.n5x5red, 1, train)
+        b3 = _conv_bn_relu(b3, self.n5x5, 3, train)
+        b3 = _conv_bn_relu(b3, self.n5x5, 3, train)
+
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+        b4 = _conv_bn_relu(b4, self.pool_planes, 1, train)
+
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+# (n1x1, n3x3red, n3x3, n5x5red, n5x5, pool_planes) per module; None = maxpool.
+_PLAN: Sequence = (
+    (64, 96, 128, 16, 32, 32),     # a3 (in 192)
+    (128, 128, 192, 32, 96, 64),   # b3 (in 256)
+    None,
+    (192, 96, 208, 16, 48, 64),    # a4 (in 480)
+    (160, 112, 224, 24, 64, 64),   # b4
+    (128, 128, 256, 24, 64, 64),   # c4
+    (112, 144, 288, 32, 64, 64),   # d4
+    (256, 160, 320, 32, 128, 128), # e4
+    None,
+    (256, 160, 320, 32, 128, 128), # a5
+    (384, 192, 384, 48, 128, 128), # b5 (out 1024)
+)
+
+
+class GoogLeNetModule(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _conv_bn_relu(x, 192, 3, train)
+        for spec in _PLAN:
+            if spec is None:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+            else:
+                x = Inception(*spec)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("googlenet")
+def GoogLeNet(num_classes: int = 10) -> nn.Module:
+    return GoogLeNetModule(num_classes=num_classes)

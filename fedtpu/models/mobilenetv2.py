@@ -1,0 +1,81 @@
+"""MobileNetV2 for CIFAR (parity: reference ``src/models/mobilenetv2.py``).
+
+Inverted-residual blocks: 1x1 expand → 3x3 depthwise → 1x1 project (linear),
+residual added when stride is 1 (with a projected shortcut if the channel
+count changes — the reference's CIFAR variant adds the shortcut whenever
+stride == 1, ``src/models/mobilenetv2.py:36-38``). Config per the reference's
+CIFAR table (stride of stage 2 and the stem lowered to 1 for 32x32 inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+# (expansion, out_channels, num_blocks, stride)
+_CFG: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 1),  # stride 2 -> 1 for CIFAR
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    expansion: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        mid = self.expansion * in_ch
+        y = conv1x1(mid)(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = nn.Conv(
+            mid,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=mid,
+            use_bias=False,
+        )(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv1x1(self.features)(y)
+        y = batch_norm(train)(y)
+        if self.stride == 1:
+            shortcut = x
+            if in_ch != self.features:
+                shortcut = batch_norm(train)(conv1x1(self.features)(x))
+            y = y + shortcut
+        return y
+
+
+class MobileNetV2Module(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(32)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for expansion, features, n, stride in _CFG:
+            for i in range(n):
+                x = InvertedResidual(
+                    features, expansion, stride if i == 0 else 1
+                )(x, train=train)
+        x = conv1x1(1280)(x)
+        x = nn.relu(batch_norm(train)(x))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("mobilenetv2")
+def MobileNetV2(num_classes: int = 10) -> nn.Module:
+    return MobileNetV2Module(num_classes=num_classes)

@@ -1,0 +1,107 @@
+"""PNASNet A/B for CIFAR (parity: reference ``src/models/pnasnet.py``).
+
+Cell A: 7x7 separable conv + 3x3 max-pool branch, summed. Cell B: two left
+branches (7x7 and 3x3 separable) and two right branches (max-pool and 5x5
+separable), pairwise-summed, concatenated and reduced by a 1x1 conv. Three
+6-cell stages at widths (p, 2p, 4p) with stride-2 cells between —
+PNASNetA (p=44), PNASNetB (p=32) (``src/models/pnasnet.py:112-116``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class SepConv(nn.Module):
+    """Depthwise-grouped k x k conv + BN (one group per input channel)."""
+
+    features: int
+    kernel_size: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = self.kernel_size
+        y = nn.Conv(
+            self.features,
+            (k, k),
+            strides=(self.stride, self.stride),
+            padding=(k - 1) // 2,
+            feature_group_count=x.shape[-1],
+            use_bias=False,
+        )(x)
+        return batch_norm(train)(y)
+
+
+class CellA(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y1 = SepConv(self.features, 7, self.stride)(x, train=train)
+        y2 = nn.max_pool(
+            x,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+        )
+        if self.stride == 2:
+            y2 = batch_norm(train)(conv1x1(self.features)(y2))
+        return nn.relu(y1 + y2)
+
+
+class CellB(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y1 = SepConv(self.features, 7, self.stride)(x, train=train)
+        y2 = SepConv(self.features, 3, self.stride)(x, train=train)
+        y3 = nn.max_pool(
+            x,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+        )
+        if self.stride == 2:
+            y3 = batch_norm(train)(conv1x1(self.features)(y3))
+        y4 = SepConv(self.features, 5, self.stride)(x, train=train)
+        b = jnp.concatenate([nn.relu(y1 + y2), nn.relu(y3 + y4)], axis=-1)
+        b = conv1x1(self.features)(b)
+        return nn.relu(batch_norm(train)(b))
+
+
+class PNASNetModule(nn.Module):
+    cell: type
+    num_cells: int
+    num_planes: int
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.num_planes
+        x = conv3x3(p)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for width, downsample in ((p, False), (2 * p, True), (4 * p, True)):
+            if downsample:
+                x = self.cell(width, stride=2)(x, train=train)
+            for _ in range(self.num_cells):
+                x = self.cell(width, stride=1)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("pnasneta")
+def PNASNetA(num_classes: int = 10) -> nn.Module:
+    return PNASNetModule(CellA, num_cells=6, num_planes=44, num_classes=num_classes)
+
+
+@register("pnasnetb")
+def PNASNetB(num_classes: int = 10) -> nn.Module:
+    return PNASNetModule(CellB, num_cells=6, num_planes=32, num_classes=num_classes)

@@ -1,0 +1,100 @@
+"""Pre-activation ResNet family (parity: reference ``src/models/preact_resnet.py``).
+
+BN→ReLU→conv ordering (He et al., identity mappings); the shortcut taps the
+*pre-activated* input when projecting. Same stage plan as ResNet: widths
+(64, 128, 256, 512), strides (1, 2, 2, 2), 3x3/64 stem, global pool + head.
+Constructors match the reference exports PreActResNet18/34/50/101/152
+(``src/models/preact_resnet.py:97-110``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class PreActBlock(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.features * self.expansion
+        pre = nn.relu(batch_norm(train)(x))
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = conv1x1(out_ch, strides=(self.stride, self.stride))(pre)
+        else:
+            shortcut = x
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(pre)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv3x3(self.features)(y)
+        return y + shortcut
+
+
+class PreActBottleneck(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.features * self.expansion
+        pre = nn.relu(batch_norm(train)(x))
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = conv1x1(out_ch, strides=(self.stride, self.stride))(pre)
+        else:
+            shortcut = x
+        y = conv1x1(self.features)(pre)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv1x1(out_ch)(y)
+        return y + shortcut
+
+
+class PreActResNetModule(nn.Module):
+    block: Type[nn.Module]
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(64)(x)
+        for stage, (features, n) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            for i in range(n):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = self.block(features=features, stride=stride)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("preactresnet18")
+def PreActResNet18(num_classes: int = 10) -> nn.Module:
+    return PreActResNetModule(PreActBlock, (2, 2, 2, 2), num_classes)
+
+
+@register("preactresnet34")
+def PreActResNet34(num_classes: int = 10) -> nn.Module:
+    return PreActResNetModule(PreActBlock, (3, 4, 6, 3), num_classes)
+
+
+@register("preactresnet50")
+def PreActResNet50(num_classes: int = 10) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 4, 6, 3), num_classes)
+
+
+@register("preactresnet101")
+def PreActResNet101(num_classes: int = 10) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 4, 23, 3), num_classes)
+
+
+@register("preactresnet152")
+def PreActResNet152(num_classes: int = 10) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 8, 36, 3), num_classes)

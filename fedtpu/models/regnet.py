@@ -1,0 +1,108 @@
+"""RegNet X/Y for CIFAR (parity: reference ``src/models/regnet.py``).
+
+Bottleneck blocks: 1x1 → grouped 3x3 (group width from config) → optional SE
+(RegNetY) → 1x1, projected shortcut on stride/width change. Stage
+depths/widths/strides per the reference configs
+(``src/models/regnet.py:110-143``): RegNetX_200MF, RegNetX_400MF,
+RegNetY_400MF.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class RegNetBlock(nn.Module):
+    features: int
+    stride: int
+    group_width: int
+    bottleneck_ratio: float = 1.0
+    se_ratio: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        w_b = int(round(self.features * self.bottleneck_ratio))
+        y = conv1x1(w_b)(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = nn.Conv(
+            w_b,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=w_b // self.group_width,
+            use_bias=False,
+        )(y)
+        y = nn.relu(batch_norm(train)(y))
+        if self.se_ratio > 0:
+            w_se = int(round(in_ch * self.se_ratio))
+            w = jnp.mean(y, axis=(1, 2), keepdims=True)
+            w = nn.relu(nn.Conv(w_se, (1, 1))(w))
+            w = nn.sigmoid(nn.Conv(w_b, (1, 1))(w))
+            y = y * w
+        y = conv1x1(self.features)(y)
+        y = batch_norm(train)(y)
+        if self.stride != 1 or in_ch != self.features:
+            shortcut = conv1x1(self.features, strides=(self.stride, self.stride))(x)
+            shortcut = batch_norm(train)(shortcut)
+        else:
+            shortcut = x
+        return nn.relu(y + shortcut)
+
+
+class RegNetModule(nn.Module):
+    depths: Sequence[int]
+    widths: Sequence[int]
+    strides: Sequence[int]
+    group_width: int
+    bottleneck_ratio: float = 1.0
+    se_ratio: float = 0.0
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(64)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for depth, width, stride in zip(self.depths, self.widths, self.strides):
+            for i in range(depth):
+                x = RegNetBlock(
+                    width,
+                    stride if i == 0 else 1,
+                    self.group_width,
+                    self.bottleneck_ratio,
+                    self.se_ratio,
+                )(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("regnetx_200mf")
+def RegNetX_200MF(num_classes: int = 10) -> nn.Module:
+    return RegNetModule(
+        (1, 1, 4, 7), (24, 56, 152, 368), (1, 1, 2, 2), 8, num_classes=num_classes
+    )
+
+
+@register("regnetx_400mf")
+def RegNetX_400MF(num_classes: int = 10) -> nn.Module:
+    return RegNetModule(
+        (1, 2, 7, 12), (32, 64, 160, 384), (1, 1, 2, 2), 16, num_classes=num_classes
+    )
+
+
+@register("regnety_400mf")
+def RegNetY_400MF(num_classes: int = 10) -> nn.Module:
+    return RegNetModule(
+        (1, 2, 7, 12),
+        (32, 64, 160, 384),
+        (1, 1, 2, 2),
+        16,
+        se_ratio=0.25,
+        num_classes=num_classes,
+    )

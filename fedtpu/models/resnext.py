@@ -1,0 +1,85 @@
+"""ResNeXt-29 family for CIFAR (parity: reference ``src/models/resnext.py``).
+
+Grouped-convolution bottleneck blocks (1x1 → grouped 3x3 → 1x1, expansion 2)
+over three stages of three blocks; the bottleneck width doubles per stage.
+Constructors match the reference exports ResNeXt29_{2x64,4x64,8x64,32x4}d
+(``src/models/resnext.py:77-87``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedtpu.models.common import batch_norm, conv1x1, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class ResNeXtBlock(nn.Module):
+    cardinality: int
+    bottleneck_width: int
+    stride: int = 1
+    expansion: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        group_width = self.cardinality * self.bottleneck_width
+        out_ch = self.expansion * group_width
+        y = conv1x1(group_width)(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = nn.Conv(
+            group_width,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=self.cardinality,
+            use_bias=False,
+        )(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv1x1(out_ch)(y)
+        y = batch_norm(train)(y)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = conv1x1(out_ch, strides=(self.stride, self.stride))(x)
+            shortcut = batch_norm(train)(shortcut)
+        else:
+            shortcut = x
+        return nn.relu(y + shortcut)
+
+
+class ResNeXtModule(nn.Module):
+    num_blocks: tuple
+    cardinality: int
+    bottleneck_width: int
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv1x1(64)(x)
+        x = nn.relu(batch_norm(train)(x))
+        width = self.bottleneck_width
+        for stage, n in enumerate(self.num_blocks):
+            for i in range(n):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = ResNeXtBlock(self.cardinality, width, stride)(x, train=train)
+            width *= 2  # bottleneck width doubles after each stage
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("resnext29_2x64d")
+def ResNeXt29_2x64d(num_classes: int = 10) -> nn.Module:
+    return ResNeXtModule((3, 3, 3), 2, 64, num_classes)
+
+
+@register("resnext29_4x64d")
+def ResNeXt29_4x64d(num_classes: int = 10) -> nn.Module:
+    return ResNeXtModule((3, 3, 3), 4, 64, num_classes)
+
+
+@register("resnext29_8x64d")
+def ResNeXt29_8x64d(num_classes: int = 10) -> nn.Module:
+    return ResNeXtModule((3, 3, 3), 8, 64, num_classes)
+
+
+@register("resnext29_32x4d")
+def ResNeXt29_32x4d(num_classes: int = 10) -> nn.Module:
+    return ResNeXtModule((3, 3, 3), 32, 4, num_classes)

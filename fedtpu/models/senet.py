@@ -1,0 +1,70 @@
+"""SENet-18 for CIFAR (parity: reference ``src/models/senet.py``).
+
+Pre-activation basic blocks with squeeze-and-excitation: a global-pooled
+1x1→ReLU→1x1→sigmoid gate (reduction 16) rescales the block output before the
+residual add. Stage plan (64, 128, 256, 512) x (2, 2, 2, 2), strides
+(1, 2, 2, 2) — ``SENet18`` (``src/models/senet.py:112``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+
+
+class SEGate(nn.Module):
+    """Squeeze-and-excitation: per-channel sigmoid gate from global context."""
+
+    reduction: int = 16
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        w = jnp.mean(x, axis=(1, 2), keepdims=True)
+        w = nn.relu(nn.Conv(ch // self.reduction, (1, 1))(w))
+        w = nn.sigmoid(nn.Conv(ch, (1, 1))(w))
+        return x * w
+
+
+class SEPreActBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pre = nn.relu(batch_norm(train)(x))
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = conv1x1(self.features, strides=(self.stride, self.stride))(pre)
+        else:
+            shortcut = x
+        y = conv3x3(self.features, strides=(self.stride, self.stride))(pre)
+        y = nn.relu(batch_norm(train)(y))
+        y = conv3x3(self.features)(y)
+        y = SEGate()(y)
+        return y + shortcut
+
+
+class SENetModule(nn.Module):
+    num_blocks: tuple = (2, 2, 2, 2)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(64)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for stage, (features, n) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            for i in range(n):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = SEPreActBlock(features, stride)(x, train=train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("senet18")
+def SENet18(num_classes: int = 10) -> nn.Module:
+    return SENetModule((2, 2, 2, 2), num_classes)

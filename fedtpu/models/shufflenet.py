@@ -1,0 +1,98 @@
+"""ShuffleNet(v1) for CIFAR (parity: reference ``src/models/shufflenet.py``).
+
+Grouped 1x1 → channel shuffle → 3x3 depthwise → grouped 1x1 bottlenecks; the
+first block of each stage strides 2 and *concatenates* an avg-pooled shortcut
+(so its conv path emits ``out - in`` channels), later blocks add the identity.
+The first stage's entry 1x1 is ungrouped (stem has only 24 channels).
+Constructors match the reference: ShuffleNetG2, ShuffleNetG3
+(``src/models/shufflenet.py:86-101``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import avg_pool, batch_norm, conv1x1, global_avg_pool
+from fedtpu.models.registry import register
+
+
+def channel_shuffle(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    """Interleave channels across groups: NHWC [..., g, C/g] -> [..., C/g, g]."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, -1, -2)
+    return x.reshape(n, h, w, c)
+
+
+def _grouped_conv1x1(features, groups):
+    return nn.Conv(
+        features, (1, 1), padding=0, feature_group_count=groups, use_bias=False
+    )
+
+
+class ShuffleBottleneck(nn.Module):
+    out_planes: int  # channels added by the conv path
+    stride: int
+    groups: int
+    first_groups: int  # 1 for the stem-fed block, else == groups
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        mid = self.out_planes // 4
+        y = _grouped_conv1x1(mid, self.first_groups)(x)
+        y = nn.relu(batch_norm(train)(y))
+        y = channel_shuffle(y, self.first_groups)
+        y = nn.Conv(
+            mid,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            feature_group_count=mid,
+            use_bias=False,
+        )(y)
+        y = nn.relu(batch_norm(train)(y))
+        y = _grouped_conv1x1(self.out_planes, self.groups)(y)
+        y = batch_norm(train)(y)
+        if self.stride == 2:
+            shortcut = avg_pool(x, 3, 2, padding=((1, 1), (1, 1)))
+            return nn.relu(jnp.concatenate([y, shortcut], axis=-1))
+        return nn.relu(y + x)
+
+
+class ShuffleNetModule(nn.Module):
+    out_planes: Sequence[int]
+    num_blocks: Sequence[int]
+    groups: int
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv1x1(24)(x)
+        x = nn.relu(batch_norm(train)(x))
+        in_planes = 24
+        for stage, (out, n) in enumerate(zip(self.out_planes, self.num_blocks)):
+            for i in range(n):
+                stride = 2 if i == 0 else 1
+                cat_planes = in_planes if i == 0 else 0
+                x = ShuffleBottleneck(
+                    out - cat_planes,
+                    stride=stride,
+                    groups=self.groups,
+                    first_groups=1 if in_planes == 24 else self.groups,
+                )(x, train=train)
+                in_planes = out
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("shufflenetg2")
+def ShuffleNetG2(num_classes: int = 10) -> nn.Module:
+    return ShuffleNetModule((200, 400, 800), (4, 8, 4), 2, num_classes)
+
+
+@register("shufflenetg3")
+def ShuffleNetG3(num_classes: int = 10) -> nn.Module:
+    return ShuffleNetModule((240, 480, 960), (4, 8, 4), 3, num_classes)

@@ -1,0 +1,102 @@
+"""ShuffleNetV2 for CIFAR (parity: reference ``src/models/shufflenetv2.py``).
+
+Basic blocks split channels 50/50, transform one half (1x1 → 3x3 depthwise →
+1x1), concat, then shuffle with 2 groups; down blocks transform both halves
+with stride 2 and concat. Size configs 0.5/1/1.5/2 follow the reference table
+(``src/models/shufflenetv2.py:141-160``); ``ShuffleNetV2(net_size)`` is the
+constructor surface.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.registry import register
+from fedtpu.models.shufflenet import channel_shuffle
+
+_CONFIGS = {
+    0.5: {"out_channels": (48, 96, 192, 1024), "num_blocks": (3, 7, 3)},
+    1: {"out_channels": (116, 232, 464, 1024), "num_blocks": (3, 7, 3)},
+    1.5: {"out_channels": (176, 352, 704, 1024), "num_blocks": (3, 7, 3)},
+    2: {"out_channels": (224, 488, 976, 2048), "num_blocks": (3, 7, 3)},
+}
+
+
+def _depthwise(features, stride):
+    return nn.Conv(
+        features,
+        (3, 3),
+        strides=(stride, stride),
+        padding=1,
+        feature_group_count=features,
+        use_bias=False,
+    )
+
+
+class SplitBlock(nn.Module):
+    split_ratio: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = int(x.shape[-1] * self.split_ratio)
+        x1, x2 = x[..., :c], x[..., c:]
+        y = conv1x1(c)(x2)
+        y = nn.relu(batch_norm(train)(y))
+        y = _depthwise(c, 1)(y)
+        y = batch_norm(train)(y)
+        y = conv1x1(c)(y)
+        y = nn.relu(batch_norm(train)(y))
+        out = jnp.concatenate([x1, y], axis=-1)
+        return channel_shuffle(out, 2)
+
+
+class DownBlock(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        mid = self.features // 2
+        # Left: depthwise stride-2 then 1x1.
+        left = _depthwise(in_ch, 2)(x)
+        left = batch_norm(train)(left)
+        left = conv1x1(mid)(left)
+        left = nn.relu(batch_norm(train)(left))
+        # Right: 1x1, depthwise stride-2, 1x1.
+        right = conv1x1(mid)(x)
+        right = nn.relu(batch_norm(train)(right))
+        right = _depthwise(mid, 2)(right)
+        right = batch_norm(train)(right)
+        right = conv1x1(mid)(right)
+        right = nn.relu(batch_norm(train)(right))
+        out = jnp.concatenate([left, right], axis=-1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2Module(nn.Module):
+    out_channels: Sequence[int]
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv3x3(24)(x)
+        x = nn.relu(batch_norm(train)(x))
+        for out, n in zip(self.out_channels[:3], self.num_blocks):
+            x = DownBlock(out)(x, train=train)
+            for _ in range(n):
+                x = SplitBlock()(x, train=train)
+        x = conv1x1(self.out_channels[3])(x)
+        x = nn.relu(batch_norm(train)(x))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register("shufflenetv2")
+def ShuffleNetV2(net_size: float = 1, num_classes: int = 10) -> nn.Module:
+    cfg = _CONFIGS[net_size]
+    return ShuffleNetV2Module(cfg["out_channels"], cfg["num_blocks"], num_classes)

@@ -10,7 +10,7 @@ from typing import Sequence, Union
 
 import flax.linen as nn
 
-from fedtpu.models.common import batch_norm, conv3x3, max_pool
+from fedtpu.models.common import batch_norm, max_pool
 from fedtpu.models.registry import register
 
 _CFGS = {
@@ -33,7 +33,9 @@ class VGGModule(nn.Module):
             if entry == "M":
                 x = max_pool(x, 2)
             else:
-                x = conv3x3(entry)(x)
+                # Biased convs, matching the reference's default Conv2d (the
+                # bias is redundant before BN but kept for exact param parity).
+                x = nn.Conv(entry, (3, 3), padding=1)(x)
                 x = batch_norm(train)(x)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))

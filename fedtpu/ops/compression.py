@@ -40,8 +40,8 @@ Pytree = Any
 class Compressor(NamedTuple):
     """A stateful delta codec.
 
-    ``init(params, num_clients)`` builds the per-client residual state (an
-    empty dict when error feedback is off). ``apply(deltas, state)`` maps
+    ``init(params, num_clients)`` builds the per-client residual state (the
+    empty tuple ``()`` when error feedback is off). ``apply(deltas, state)`` maps
     stacked per-client deltas ``[clients, ...]`` to (compressed deltas, new
     state). ``apply`` is pure and jit/shard_map-safe; under ``shard_map`` the
     clients axis of both deltas and state is the sharded axis.
@@ -71,6 +71,15 @@ def _make_init(error_feedback: bool) -> Callable[[Pytree, int], Pytree]:
     return init
 
 
+class _CodecPair(NamedTuple):
+    """Sentinel wrapper for one leaf's (compressed, new_residual) result, so
+    unzipping the mapped tree can't confuse codec outputs with tuple
+    containers that happen to appear inside a caller's delta pytree."""
+
+    compressed: jnp.ndarray
+    residual: Optional[jnp.ndarray]
+
+
 def _make_apply(
     leaf: Callable[[jnp.ndarray, Optional[jnp.ndarray]], Tuple[jnp.ndarray, jnp.ndarray]],
     error_feedback: bool,
@@ -80,14 +89,14 @@ def _make_apply(
 
     def apply(deltas: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
         if error_feedback:
-            pairs = jax.tree.map(leaf, deltas, state)
+            pairs = jax.tree.map(lambda d, e: _CodecPair(*leaf(d, e)), deltas, state)
         else:
-            pairs = jax.tree.map(lambda d: leaf(d, None), deltas)
-        is_pair = lambda x: isinstance(x, tuple) and not isinstance(x, jnp.ndarray)
-        out = jax.tree.map(lambda p: p[0], pairs, is_leaf=is_pair)
+            pairs = jax.tree.map(lambda d: _CodecPair(*leaf(d, None)), deltas)
+        is_pair = lambda x: isinstance(x, _CodecPair)
+        out = jax.tree.map(lambda p: p.compressed, pairs, is_leaf=is_pair)
         if not error_feedback:
             return out, state
-        new_state = jax.tree.map(lambda p: p[1], pairs, is_leaf=is_pair)
+        new_state = jax.tree.map(lambda p: p.residual, pairs, is_leaf=is_pair)
         return out, new_state
 
     return apply

@@ -7,7 +7,6 @@ full round step running with compression enabled (residuals carried in
 FederatedState.comp_state).
 """
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

@@ -57,6 +57,9 @@ class DataConfig:
     dirichlet_alpha: float = 0.5
     augment: bool = True  # random crop + flip (reference: src/main.py:37-42)
     seed: int = 0
+    # Truncate the loaded dataset (None = full). Mainly for tests and quick
+    # runs; the reference always trains on the full set.
+    num_examples: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -72,7 +72,12 @@ class Federation:
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
 
         if data is None:
-            images, labels = load(cfg.data.dataset, "train", seed=cfg.data.seed)
+            images, labels = load(
+                cfg.data.dataset,
+                "train",
+                seed=cfg.data.seed,
+                num=cfg.data.num_examples,
+            )
         else:
             images, labels = data
         self.images, self.labels = images, labels
@@ -150,7 +155,8 @@ class Federation:
         eval_every: int = 0,
         eval_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ) -> RoundMetrics:
-        num_rounds = num_rounds or self.cfg.fed.num_rounds
+        if num_rounds is None:
+            num_rounds = self.cfg.fed.num_rounds
         metrics = None
         self.eval_history = []
         for r in range(num_rounds):

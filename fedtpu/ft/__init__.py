@@ -1,0 +1,28 @@
+"""Fault tolerance: client failure detection + primary/backup failover.
+
+Two distinct mechanisms, as in the reference (SURVEY §5):
+- client failure — :mod:`fedtpu.ft.heartbeat`: RpcError marks dead, 1 Hz
+  probe revives + resyncs (reference ``src/server.py:51-101``); the registry's
+  alive mask feeds the jitted engine's ``RoundBatch.alive``.
+- server failure — :mod:`fedtpu.ft.failover`: CheckIfPrimaryUp pings, 10 s
+  watchdog, promote/demote state machine with per-round model replication
+  (reference ``src/server.py:183-264``), rebuilt event-driven and
+  fake-clock-testable.
+"""
+
+from fedtpu.ft.heartbeat import ClientRegistry, HeartbeatMonitor
+from fedtpu.ft.failover import (
+    FailoverStateMachine,
+    PrimaryPinger,
+    Role,
+    WatchdogRunner,
+)
+
+__all__ = [
+    "ClientRegistry",
+    "HeartbeatMonitor",
+    "FailoverStateMachine",
+    "PrimaryPinger",
+    "Role",
+    "WatchdogRunner",
+]

@@ -1,0 +1,168 @@
+"""Primary/backup failover state machine.
+
+Reference semantics (``src/server.py:183-264``): the primary pings the backup
+1x/s with ``CheckIfPrimaryUp(req=str(recovering))``; the backup's watchdog
+promotes itself (via SIGUSR1) if no ping lands within a ~10 s window; when
+the real primary returns (first ping carries ``req=="1"``) the acting
+primary demotes back to backup. The global model survives failover because
+the primary replicates it to the backup every round via SendModel
+(``src/server.py:141-142,236-242``).
+
+This module reimplements that protocol as a *pure, event-driven* state
+machine — ``on_ping`` / ``check_watchdog`` transitions with an injected
+clock, promotion/demotion as callbacks — instead of signal handlers and
+un-killable threads. (The reference's demotion path calls
+``threading.Thread.terminate()``, which does not exist, so its demotion
+would crash with AttributeError — ``src/server.py:230``; a known reference
+bug we do not replicate.)
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Role(enum.Enum):
+    PRIMARY = "primary"
+    BACKUP = "backup"
+    ACTING_PRIMARY = "acting_primary"
+
+
+class FailoverStateMachine:
+    """Backup-side protocol logic.
+
+    Events:
+      - :meth:`on_ping`   — a CheckIfPrimaryUp arrived from the primary.
+      - :meth:`check_watchdog` — periodic liveness check.
+
+    Transitions:
+      - BACKUP --[watchdog expiry]--> ACTING_PRIMARY  (on_promote)
+      - ACTING_PRIMARY --[ping with recovering=True]--> BACKUP  (on_demote)
+    """
+
+    def __init__(
+        self,
+        timeout: float = 10.0,
+        on_promote: Optional[Callable[[], None]] = None,
+        on_demote: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.timeout = timeout
+        self.on_promote = on_promote
+        self.on_demote = on_demote
+        self.clock = clock
+        self.role = Role.BACKUP
+        self._last_ping = clock()
+        self._lock = threading.Lock()
+
+    def on_ping(self, recovering: bool) -> int:
+        """Handle one CheckIfPrimaryUp; returns the PingResponse value
+        (1 = "I am acting primary and will now demote", matching the
+        reference's servicer reply, ``src/server.py:244-252``)."""
+        demote = False
+        with self._lock:
+            self._last_ping = self.clock()
+            # The returning primary announces itself with recovering=True;
+            # an acting primary yields control back.
+            if recovering and self.role is Role.ACTING_PRIMARY:
+                self.role = Role.BACKUP
+                demote = True
+        if demote:
+            if self.on_demote is not None:
+                self.on_demote()
+            return 1
+        return 0
+
+    def check_watchdog(self) -> bool:
+        """Promote if the primary has been silent past the timeout. Returns
+        True when a promotion happened on this call."""
+        promote = False
+        with self._lock:
+            if (
+                self.role is Role.BACKUP
+                and self.clock() - self._last_ping > self.timeout
+            ):
+                self.role = Role.ACTING_PRIMARY
+                promote = True
+        if promote and self.on_promote is not None:
+            self.on_promote()
+        return promote
+
+    def seconds_since_ping(self) -> float:
+        with self._lock:
+            return self.clock() - self._last_ping
+
+
+class PrimaryPinger:
+    """Primary-side 1 Hz pinger (parity: ``pingBackupServer``,
+    ``src/server.py:188-200``): sends ``recovering`` on the first ping after
+    (re)start, clears it once delivered. ``send(recovering) -> Optional[int]``
+    is injected (None = backup unreachable, which the primary tolerates)."""
+
+    def __init__(
+        self,
+        send: Callable[[bool], Optional[int]],
+        period: float = 1.0,
+        recovering: bool = True,
+    ):
+        self.send = send
+        self.period = period
+        self.recovering = recovering
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> Optional[int]:
+        result = self.send(self.recovering)
+        if result is not None:
+            # Delivered: the backup has seen our recovering flag.
+            self.recovering = False
+        return result
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            self.tick()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class WatchdogRunner:
+    """Drives ``FailoverStateMachine.check_watchdog`` on a period — the
+    thread-shaped replacement for the reference's ``CheckingIfPrimaryServerUp``
+    loop + SIGUSR1 self-kill (``src/server.py:254-264``)."""
+
+    def __init__(self, machine: FailoverStateMachine, period: float = 1.0):
+        self.machine = machine
+        self.period = period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            self.machine.check_watchdog()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,127 @@
+"""Client failure detection + recovery.
+
+Reference semantics (``src/server.py:51-101``): any RpcError during
+StartTrain/SendModel marks a client inactive; a 1 Hz daemon re-probes
+inactive clients with HeartBeat and, on success, restores the channel and
+re-pushes the current global model so the client rejoins the next round.
+
+Here that is a :class:`ClientRegistry` (the alive-mask authority — the jitted
+engine consumes its mask as ``RoundBatch.alive``) plus a
+:class:`HeartbeatMonitor` whose probe/recover/clock hooks are injected, so
+the whole recovery loop is testable in-process with fake clients and a fake
+clock (the reference's only test was manually killing processes, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class ClientRegistry:
+    """Thread-safe alive/dead registry keyed by client id.
+
+    The reference keeps this as a bare dict mutated from three threads with
+    no lock (``src/server.py:31,59-62,95-99``); we lock.
+    """
+
+    def __init__(self, clients: List[str]):
+        self._order = list(clients)
+        self._alive: Dict[str, bool] = {c: True for c in clients}
+        self._lock = threading.Lock()
+
+    @property
+    def clients(self) -> List[str]:
+        return list(self._order)
+
+    def mark_failed(self, client: str) -> None:
+        with self._lock:
+            self._alive[client] = False
+
+    def mark_alive(self, client: str) -> None:
+        with self._lock:
+            self._alive[client] = True
+
+    def is_alive(self, client: str) -> bool:
+        with self._lock:
+            return self._alive[client]
+
+    def dead_clients(self) -> List[str]:
+        with self._lock:
+            return [c for c in self._order if not self._alive[c]]
+
+    def active_clients(self) -> List[str]:
+        """Clients that would receive ranks this round. Rank assignment
+        follows the reference: ranks are indices among *active* clients in
+        registry order, while ``world`` stays the total client count
+        (``src/server.py:126-129``)."""
+        with self._lock:
+            return [c for c in self._order if self._alive[c]]
+
+    def alive_mask(self) -> np.ndarray:
+        with self._lock:
+            return np.array([self._alive[c] for c in self._order], bool)
+
+
+class HeartbeatMonitor:
+    """Re-probe dead clients; resync + revive on heartbeat success.
+
+    ``probe(client) -> bool`` and ``resync(client) -> None`` are injected
+    (in production: a HeartBeat RPC and a SendModel push of the current
+    global model — exactly the reference's ``checkClientStatus``,
+    ``src/server.py:78-101``).
+    """
+
+    def __init__(
+        self,
+        registry: ClientRegistry,
+        probe: Callable[[str], bool],
+        resync: Callable[[str], None],
+        period: float = 1.0,
+    ):
+        self.registry = registry
+        self.probe = probe
+        self.resync = resync
+        self.period = period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> List[str]:
+        """One probe pass; returns the clients recovered this pass.
+
+        Resync happens *before* the client is marked alive so a revived
+        client never receives a StartTrain ahead of the current global model
+        (the reference does the same: sendOptimizedModel, then
+        ``clients[client] = True``, ``src/server.py:95-99``).
+        """
+        recovered = []
+        for client in self.registry.dead_clients():
+            if self.probe(client):
+                try:
+                    self.resync(client)
+                except Exception:
+                    continue  # still unreachable; retry next tick
+                self.registry.mark_alive(client)
+                recovered.append(client)
+        return recovered
+
+    # ------------------------------------------------------- thread runner
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            self.tick()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

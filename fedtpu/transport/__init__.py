@@ -1,0 +1,34 @@
+"""DCN-edge transport: the gRPC ``federated.Trainer`` surface + wire codec.
+
+Intra-pod model exchange in fedtpu is XLA collectives over ICI
+(:mod:`fedtpu.parallel`) — no host transport at all. This package is the
+*edge*: a reference-compatible gRPC service (same RPCs, same method paths,
+same field numbers as ``federated.proto``) for cross-pod/DCN federation and
+interop, with raw-bytes payloads replacing the reference's base64 pickle
+(``src/client.py:19-23``).
+"""
+
+from fedtpu.transport import proto, wire
+from fedtpu.transport.service import (
+    MAX_MESSAGE_BYTES,
+    SERVICE_NAME,
+    TrainerServicer,
+    TrainerStub,
+    add_trainer_servicer,
+    create_channel,
+    create_server,
+    probe,
+)
+
+__all__ = [
+    "proto",
+    "wire",
+    "MAX_MESSAGE_BYTES",
+    "SERVICE_NAME",
+    "TrainerServicer",
+    "TrainerStub",
+    "add_trainer_servicer",
+    "create_channel",
+    "create_server",
+    "probe",
+]

@@ -1,0 +1,219 @@
+"""Hand-rolled proto3 codec for the reference's ``federated.proto`` schema.
+
+The reference generates ``federated_pb2.py`` with protoc
+(``federated.proto:24-63``); this environment has no Python protoc plugin, so
+the eight messages are encoded/decoded directly — they are tiny (at most two
+scalar fields each) and the proto3 wire format for them is just field-tagged
+varints and length-delimited blobs. Field numbers and wire types match the
+reference schema exactly, so these bytes interoperate with any stock
+``federated_pb2`` peer:
+
+    TrainRequest{rank=1:int32, world=2:int32}     (federated.proto:39-42)
+    TrainReply{message=1}                         (:45-47)
+    SendModelRequest{model=1}                     (:49-51)
+    SendModelReply{reply=1}                       (:53-55)
+    Request{}                                     (:31)
+    HeartBeatResponse{status=1:int32}             (:33-36)
+    PingRequest{req=1}                            (:57-59)
+    PingResponse{value=1:int32}                   (:61-63)
+
+One deliberate divergence: payload fields (``TrainReply.message``,
+``SendModelRequest.model``) are treated as *bytes*, not UTF-8 strings. Proto3
+strings and bytes share wire type 2, but gRPC's protobuf runtime rejects
+non-UTF-8 strings — which is exactly why the reference pays the 33% base64
+tax (``src/client.py:21``). Owning the codec lets raw model bytes ride the
+same field number with zero inflation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+_VARINT = 0
+_LEN = 2
+
+
+class ProtoError(ValueError):
+    """Malformed message bytes."""
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    if value < 0:
+        value += 1 << 64  # proto int32 negatives are 10-byte varints
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ProtoError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ProtoError("varint too long")
+    return result, pos
+
+
+def _encode_fields(fields: List[Tuple[int, int, object]]) -> bytes:
+    """fields: [(field_number, wire_type, value)]; proto3 default values
+    (0 / empty) are omitted, matching canonical encoders."""
+    out = bytearray()
+    for num, wtype, value in fields:
+        if wtype == _VARINT:
+            if value == 0:
+                continue
+            _write_varint(out, (num << 3) | _VARINT)
+            _write_varint(out, int(value))
+        elif wtype == _LEN:
+            if not value:
+                continue
+            _write_varint(out, (num << 3) | _LEN)
+            _write_varint(out, len(value))
+            out += value
+        else:
+            raise ProtoError(f"unsupported wire type {wtype}")
+    return bytes(out)
+
+
+def _decode_fields(data: bytes) -> Dict[int, object]:
+    """Last-one-wins scalar decode (proto3 semantics); unknown fields are
+    skipped, as generated code does."""
+    fields: Dict[int, object] = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        num, wtype = key >> 3, key & 0x7
+        if wtype == _VARINT:
+            value, pos = _read_varint(data, pos)
+            fields[num] = value
+        elif wtype == _LEN:
+            size, pos = _read_varint(data, pos)
+            if pos + size > len(data):
+                raise ProtoError("truncated length-delimited field")
+            fields[num] = data[pos : pos + size]
+            pos += size
+        elif wtype in (5, 1):  # fixed32 / fixed64 — skip
+            width = 4 if wtype == 5 else 8
+            if pos + width > len(data):
+                raise ProtoError("truncated fixed-width field")
+            pos += width
+        else:
+            raise ProtoError(f"unsupported wire type {wtype}")
+    return fields
+
+
+def _int32(value: int) -> int:
+    """Reinterpret a decoded uint64 varint as int32 (sign wrap)."""
+    value &= 0xFFFFFFFF
+    return value - (1 << 32) if value >= 1 << 31 else value
+
+
+@dataclasses.dataclass
+class TrainRequest:
+    rank: int = 0
+    world: int = 0
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _VARINT, self.rank), (2, _VARINT, self.world)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TrainRequest":
+        f = _decode_fields(data)
+        return cls(rank=_int32(f.get(1, 0)), world=_int32(f.get(2, 0)))
+
+
+@dataclasses.dataclass
+class TrainReply:
+    message: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.message)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TrainReply":
+        return cls(message=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class SendModelRequest:
+    model: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.model)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SendModelRequest":
+        return cls(model=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class SendModelReply:
+    reply: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.reply)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SendModelReply":
+        return cls(reply=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class Request:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Request":
+        _decode_fields(data)  # validate framing of any unknown fields
+        return cls()
+
+
+@dataclasses.dataclass
+class HeartBeatResponse:
+    status: int = 0
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _VARINT, self.status)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HeartBeatResponse":
+        return cls(status=_int32(_decode_fields(data).get(1, 0)))
+
+
+@dataclasses.dataclass
+class PingRequest:
+    req: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.req)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PingRequest":
+        return cls(req=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class PingResponse:
+    value: int = 0
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _VARINT, self.value)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PingResponse":
+        return cls(value=_int32(_decode_fields(data).get(1, 0)))

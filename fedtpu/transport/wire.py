@@ -1,0 +1,76 @@
+"""Model-payload wire format for the DCN/gRPC edge.
+
+The reference ships models as ``base64(pickle(torch state_dict))`` inside a
+proto *string* field (``src/client.py:19-23``, ``src/server.py:55-58``) — a
+33% inflation before any compression, plus pickle's arbitrary-code-execution
+surface. fedtpu's edge payload is a flax msgpack pytree (raw little-endian
+array bytes, no base64, no pickle) with a small framed header:
+
+    magic(4) | version(1) | flags(1) | crc32(4) | payload
+
+``flags`` bit 0 marks zlib compression of the payload — the explicit,
+measurable form of the reference's transport-gzip ``-c Y`` switch
+(``src/server.py:104-107``). The CRC covers the (possibly compressed)
+payload so corrupted replication streams fail loudly instead of averaging
+garbage into the global model.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+Pytree = Any
+
+_MAGIC = b"FTP1"
+_VERSION = 1
+_FLAG_ZLIB = 1
+_HEADER = struct.Struct("<4sBBI")
+
+
+class WireError(ValueError):
+    """Malformed or corrupted payload."""
+
+
+def encode(tree: Pytree, compress: bool = False, level: int = 6) -> bytes:
+    """Serialize a pytree of arrays to framed bytes.
+
+    Device arrays are fetched to host first (one transfer per leaf); for the
+    intra-pod path this function is never called — arrays stay in HBM.
+    """
+    host = jax.tree.map(np.asarray, tree)
+    payload = serialization.to_bytes(host)
+    flags = 0
+    if compress:
+        payload = zlib.compress(payload, level)
+        flags |= _FLAG_ZLIB
+    header = _HEADER.pack(_MAGIC, _VERSION, flags, zlib.crc32(payload) & 0xFFFFFFFF)
+    return header + payload
+
+
+def decode(data: bytes, like: Pytree) -> Pytree:
+    """Inverse of :func:`encode`. ``like`` supplies the pytree structure and
+    leaf dtypes (flax msgpack restores *into* a template)."""
+    if len(data) < _HEADER.size or data[:4] != _MAGIC:
+        raise WireError("not a fedtpu wire payload")
+    _, version, flags, crc = _HEADER.unpack_from(data)
+    if version != _VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    payload = data[_HEADER.size :]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("payload CRC mismatch")
+    if flags & _FLAG_ZLIB:
+        payload = zlib.decompress(payload)
+    return serialization.from_bytes(like, payload)
+
+
+def payload_size(tree: Pytree) -> int:
+    """Uncompressed wire size in bytes (sans header) — the number the
+    reference inflates by 4/3 with base64 (``src/client.py:21``)."""
+    host = jax.tree.map(np.asarray, tree)
+    return len(serialization.to_bytes(host))

@@ -1,0 +1,198 @@
+"""End-to-end distributed federation over real gRPC on localhost.
+
+The in-process analogue of the reference's README run instructions (start
+backup, primary, clients on distinct ports — its de facto integration test,
+SURVEY §4), plus the failure drills that the reference could only do by
+killing processes: client death mid-federation, heartbeat revival, and
+backup promotion/demotion.
+
+Everything runs tiny (MLP on synthetic data) so the jitted local updates
+compile in seconds on the CPU mesh.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.transport import proto, wire
+from fedtpu.transport.federation import (
+    BackupServer,
+    ClientAgent,
+    PrimaryServer,
+    serve_client,
+)
+from fedtpu.transport.service import TrainerStub, create_channel
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def tiny_cfg(num_clients=2) -> RoundConfig:
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic",
+            batch_size=8,
+            eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(num_clients=num_clients, num_rounds=2),
+        steps_per_round=2,
+    )
+
+
+@pytest.fixture()
+def two_clients():
+    cfg = tiny_cfg()
+    addrs, servers, agents = [], [], []
+    for i in range(2):
+        addr = f"localhost:{free_port()}"
+        server, agent = serve_client(addr, cfg, seed=i)
+        addrs.append(addr)
+        servers.append(server)
+        agents.append(agent)
+    yield cfg, addrs, agents
+    for s in servers:
+        s.stop(0)
+
+
+def test_two_client_round(two_clients):
+    cfg, addrs, agents = two_clients
+    primary = PrimaryServer(cfg, addrs)
+    rec = primary.round()
+    assert rec["participants"] == 2
+    assert rec["alive"] == [True, True]
+    # Both clients installed + evaluated the broadcast global model.
+    assert agents[0].last_eval is not None
+    assert agents[1].last_eval is not None
+
+
+def test_training_actually_learns(two_clients):
+    cfg, addrs, agents = two_clients
+    primary = PrimaryServer(cfg, addrs)
+    for _ in range(6):
+        primary.round()
+    # Synthetic data is linearly-ish separable; 6 rounds of federated MLP
+    # training should beat chance (0.25) clearly on the client-side eval.
+    accs = [agent.last_eval[1] for agent in agents]
+    assert max(accs) > 0.5, accs
+
+
+def test_client_failure_marks_dead_and_round_survives(two_clients):
+    cfg, addrs, agents = two_clients
+    dead_addr = f"localhost:{free_port()}"  # nothing listening -> fails fast
+    primary = PrimaryServer(cfg, [addrs[0], dead_addr])
+    rec = primary.round()
+    assert rec["participants"] == 1
+    assert rec["alive"] == [True, False]
+    # The dead client is excluded from the next round's rank fan-out but
+    # world stays at the full registry size (reference: src/server.py:126-129).
+    assert primary.registry.active_clients() == [addrs[0]]
+
+
+def test_heartbeat_revives_and_resyncs(two_clients):
+    cfg, addrs, agents = two_clients
+    primary = PrimaryServer(cfg, addrs)
+    primary.round()
+    primary.registry.mark_failed(addrs[1])
+    agents[1].last_eval = None
+    recovered = primary.monitor.tick()
+    assert recovered == [addrs[1]]
+    # Revival pushed the current global model (SendModel -> eval ran).
+    assert agents[1].last_eval is not None
+    assert primary.registry.alive_mask().tolist() == [True, True]
+
+
+def test_model_replicates_to_backup(two_clients):
+    cfg, addrs, agents = two_clients
+    backup_addr = f"localhost:{free_port()}"
+    backup = BackupServer(cfg, addrs, watchdog_timeout=3600.0)
+    backup_server = backup.start(backup_addr)
+    try:
+        primary = PrimaryServer(cfg, addrs, backup_address=backup_addr)
+        primary.round()
+        assert backup.latest_model is not None
+        # The replicated payload decodes into the current global model.
+        from fedtpu.transport.federation import _model_template
+
+        params, stats = _model_template(primary.model, cfg)
+        tree = wire.decode(
+            backup.latest_model, {"params": params, "batch_stats": stats}
+        )
+        ours = np.concatenate(
+            [np.ravel(x) for x in map(np.asarray, _leaves(primary.params))]
+        )
+        theirs = np.concatenate(
+            [np.ravel(x) for x in map(np.asarray, _leaves(tree["params"]))]
+        )
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+    finally:
+        backup.watchdog.stop()
+        backup_server.stop(0)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def test_backup_promotes_and_demotes(two_clients):
+    """Kill the primary (stop pinging), watch the backup take over rounds,
+    then bring the primary back and watch it yield."""
+    cfg, addrs, agents = two_clients
+    backup_addr = f"localhost:{free_port()}"
+    backup = BackupServer(cfg, addrs, watchdog_timeout=1.0)
+    backup.machine.clock = time.monotonic  # real clock, short window
+    backup_server = backup.start(backup_addr)
+    stub = TrainerStub(create_channel(backup_addr))
+    try:
+        # Seed replication state, as the primary would every round.
+        primary = PrimaryServer(cfg, addrs, backup_address=backup_addr)
+        primary.round()
+        # Primary goes silent -> watchdog fires within ~2 ticks.
+        deadline = time.time() + 15
+        while backup.acting is None and time.time() < deadline:
+            time.sleep(0.2)
+        assert backup.acting is not None, "backup never promoted"
+        # Acting primary actually drives rounds with the replicated model.
+        deadline = time.time() + 30
+        while not backup.acting.history and time.time() < deadline:
+            time.sleep(0.2)
+        assert backup.acting.history, "acting primary ran no rounds"
+        # The real primary returns: its recovering ping demotes the backup
+        # AND pulls the acting primary's newer model (FetchModel) before
+        # training — progress from the failover window survives.
+        primary2 = PrimaryServer(cfg, addrs, backup_address=backup_addr)
+        primary2.run(num_rounds=0)  # run() pings synchronously before rounds
+        from fedtpu.ft import Role
+
+        assert backup.machine.role is Role.BACKUP
+        import jax
+
+        ours = np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree.leaves(primary2.params)]
+        )
+        theirs = np.concatenate(
+            [
+                np.ravel(np.asarray(x))
+                for x in jax.tree.leaves(backup.acting.params)
+            ]
+        )
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+    finally:
+        backup.watchdog.stop()
+        backup_server.stop(0)

@@ -1,0 +1,142 @@
+"""Fault tolerance: heartbeat recovery + primary/backup failover.
+
+The reference's only failure test was manually killing processes (SURVEY §4);
+these drive the same protocol in-process with fake probes and a fake clock.
+"""
+
+import numpy as np
+import pytest
+
+from fedtpu.ft import (
+    ClientRegistry,
+    FailoverStateMachine,
+    HeartbeatMonitor,
+    Role,
+)
+
+
+# ------------------------------------------------------------- registry
+def test_registry_masks_and_ranks():
+    reg = ClientRegistry(["a", "b", "c"])
+    assert reg.active_clients() == ["a", "b", "c"]
+    reg.mark_failed("b")
+    # Ranks go to active clients in registry order; world stays 3
+    # (reference: src/server.py:126-129).
+    assert reg.active_clients() == ["a", "c"]
+    np.testing.assert_array_equal(reg.alive_mask(), [True, False, True])
+    reg.mark_alive("b")
+    assert reg.active_clients() == ["a", "b", "c"]
+
+
+# ------------------------------------------------------------ heartbeat
+def test_heartbeat_recovery_resyncs_before_revive():
+    reg = ClientRegistry(["a", "b"])
+    reg.mark_failed("b")
+    events = []
+    up = {"b": False}
+
+    monitor = HeartbeatMonitor(
+        reg,
+        probe=lambda c: up[c],
+        resync=lambda c: events.append(("resync", c, reg.is_alive(c))),
+    )
+    assert monitor.tick() == []          # still down
+    assert not reg.is_alive("b")
+    up["b"] = True
+    assert monitor.tick() == ["b"]       # probe succeeds -> resync + revive
+    # Resync ran while the client was still marked dead (so no StartTrain
+    # can race ahead of the model push — reference order src/server.py:95-99).
+    assert events == [("resync", "b", False)]
+    assert reg.is_alive("b")
+    assert monitor.tick() == []          # idempotent
+
+
+def test_heartbeat_resync_failure_keeps_dead():
+    reg = ClientRegistry(["a"])
+    reg.mark_failed("a")
+
+    def bad_resync(c):
+        raise RuntimeError("connection dropped mid-push")
+
+    monitor = HeartbeatMonitor(reg, probe=lambda c: True, resync=bad_resync)
+    assert monitor.tick() == []
+    assert not reg.is_alive("a")
+
+
+# -------------------------------------------------------------- failover
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_watchdog_promotes_after_timeout():
+    clock = FakeClock()
+    events = []
+    m = FailoverStateMachine(
+        timeout=10.0,
+        on_promote=lambda: events.append("promote"),
+        on_demote=lambda: events.append("demote"),
+        clock=clock,
+    )
+    assert m.role is Role.BACKUP
+    clock.advance(9.0)
+    assert not m.check_watchdog()       # inside window
+    m.on_ping(recovering=False)         # ping resets the window
+    clock.advance(9.0)
+    assert not m.check_watchdog()
+    clock.advance(2.0)
+    assert m.check_watchdog()           # 11 s of silence -> promote
+    assert m.role is Role.ACTING_PRIMARY
+    assert events == ["promote"]
+    # No double promotion.
+    clock.advance(100.0)
+    assert not m.check_watchdog()
+
+
+def test_recovering_primary_demotes_acting_primary():
+    clock = FakeClock()
+    events = []
+    m = FailoverStateMachine(
+        timeout=10.0,
+        on_promote=lambda: events.append("promote"),
+        on_demote=lambda: events.append("demote"),
+        clock=clock,
+    )
+    clock.advance(11.0)
+    m.check_watchdog()
+    assert m.role is Role.ACTING_PRIMARY
+    # Ordinary pings (no recovering flag) do NOT demote.
+    assert m.on_ping(recovering=False) == 0
+    assert m.role is Role.ACTING_PRIMARY
+    # The returning primary's recovering ping does; reply value 1 tells the
+    # primary the backup was acting (reference: src/server.py:244-252).
+    assert m.on_ping(recovering=True) == 1
+    assert m.role is Role.BACKUP
+    assert events == ["promote", "demote"]
+
+
+def test_recovering_ping_in_backup_role_is_noop():
+    clock = FakeClock()
+    m = FailoverStateMachine(timeout=10.0, clock=clock)
+    assert m.on_ping(recovering=True) == 0
+    assert m.role is Role.BACKUP
+
+
+def test_full_failover_cycle():
+    """backup -> acting primary -> demoted -> promoted again."""
+    clock = FakeClock()
+    m = FailoverStateMachine(timeout=10.0, clock=clock)
+    clock.advance(11.0)
+    assert m.check_watchdog()
+    assert m.on_ping(recovering=True) == 1
+    assert m.role is Role.BACKUP
+    # Primary dies again.
+    clock.advance(11.0)
+    assert m.check_watchdog()
+    assert m.role is Role.ACTING_PRIMARY

@@ -1,0 +1,26 @@
+"""Checkpoint / resume.
+
+The reference's ``.pth`` files quadruple as RPC payloads, FedAvg inputs,
+replication state, and resume points (``src/main.py:87-96,160-165``,
+``src/server.py:34,174-179``; SURVEY §5). fedtpu separates concerns: the
+transport payload is :mod:`fedtpu.transport.wire`; *checkpoints* are this
+module — round-granularity snapshots of the full
+:class:`fedtpu.core.round.FederatedState` (global model + per-client
+momentum + RNG + compressor residuals), so resume reproduces the exact
+training trajectory, not just the weights.
+
+Two backends behind one API:
+- ``orbax`` (directory-per-step, async-capable, the standard JAX tool) when
+  available;
+- the framed wire codec (single file, CRC-checked) as fallback — also the
+  format used for cross-host replication blobs.
+"""
+
+from fedtpu.checkpoint.checkpoint import (
+    Checkpointer,
+    latest_round,
+    restore,
+    save,
+)
+
+__all__ = ["Checkpointer", "latest_round", "restore", "save"]

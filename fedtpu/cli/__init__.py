@@ -1,0 +1,3 @@
+"""Process entry points: ``fedtpu.cli.run`` (TPU-native simulated
+federation), ``fedtpu.cli.server`` (primary/backup over gRPC),
+``fedtpu.cli.client`` (client agent) — the L5 surface of SURVEY §1."""

@@ -1,0 +1,42 @@
+"""``python -m fedtpu.cli.client`` — federated client agent.
+
+Parity with ``python3 client.py -a localhost:50051`` (``src/client.py:55-71``):
+hosts the ``Trainer`` gRPC server and trains on StartTrain. Unlike the
+reference there are no import-time side effects (``src/client.py:9`` imports
+``main``, which parses argv, downloads CIFAR, and builds the model at import —
+SURVEY §3.2); everything is constructed explicitly here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from fedtpu.cli.common import add_model_flags, build_config, compress_enabled
+from fedtpu.transport.federation import serve_client
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_model_flags(p)
+    p.add_argument("-a", "--address", default="localhost:50051",
+                   help="bind address (doubles as the client's identity)")
+    p.add_argument("--world", default=2, type=int,
+                   help="total client count (for config only; actual world "
+                   "arrives with each StartTrain)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    cfg = build_config(args, num_clients=args.world)
+    server, _agent = serve_client(
+        args.address, cfg, seed=args.seed, compress=compress_enabled(args)
+    )
+    logging.info("client agent serving on %s", args.address)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

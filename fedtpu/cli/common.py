@@ -1,0 +1,105 @@
+"""Shared CLI flag surface.
+
+The reference splits flags across three argparse parsers with cross-process
+coupling (``src/server.py:270-274``, ``src/client.py:56-59``,
+``src/main.py:20-26`` — the trainer's parser runs inside the client process
+because of import-time side effects). fedtpu keeps the reference's flag
+*names* where they exist (``-c/--compressFlag``, ``-a/--address``,
+``-r/--resume``, ``--lr``, ``--p``) and adds explicit flags for everything
+the reference hardcodes (model, dataset, rounds, client registry).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.data import dataset_info
+
+
+def add_model_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--model",
+        default="MobileNet",
+        help="architecture (reference hardcodes MobileNet, src/main.py:69)",
+    )
+    p.add_argument(
+        "--dataset",
+        default="cifar10",
+        choices=["cifar10", "cifar100", "mnist", "synthetic"],
+    )
+    p.add_argument("--lr", default=0.1, type=float, help="learning rate")
+    p.add_argument("--batch-size", default=128, type=int)
+    p.add_argument("--seed", default=0, type=int)
+    p.add_argument(
+        "--num-examples",
+        default=None,
+        type=int,
+        help="truncate the dataset (for smoke runs)",
+    )
+    p.add_argument(
+        "-c",
+        "--compressFlag",
+        default="N",
+        help="Y enables update compression (reference: transport gzip; here "
+        "additionally top-k delta compression on the TPU path)",
+    )
+
+
+def add_fed_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--rounds", default=20, type=int,
+                   help="federated rounds (reference hardcodes 20)")
+    p.add_argument("--algorithm", default="fedavg", choices=["fedavg", "fedprox"])
+    p.add_argument("--fedprox-mu", default=0.01, type=float)
+    p.add_argument(
+        "--partition",
+        default="round_robin",
+        choices=["round_robin", "iid", "dirichlet"],
+    )
+    p.add_argument("--dirichlet-alpha", default=0.5, type=float)
+    p.add_argument(
+        "--compression",
+        default=None,
+        choices=["none", "topk", "int8"],
+        help="delta codec; default: topk when -c Y, none otherwise",
+    )
+    p.add_argument("--topk-fraction", default=0.01, type=float)
+
+
+def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
+    compress = str(getattr(args, "compressFlag", "N")).upper() == "Y"
+    compression = getattr(args, "compression", None)
+    if compression is None:
+        compression = "topk" if compress else "none"
+    shape, n_classes = dataset_info(args.dataset)
+    return RoundConfig(
+        model=args.model,
+        num_classes=n_classes,
+        image_size=shape,
+        opt=OptimizerConfig(learning_rate=args.lr),
+        data=DataConfig(
+            dataset=args.dataset,
+            batch_size=args.batch_size,
+            partition=getattr(args, "partition", "round_robin"),
+            dirichlet_alpha=getattr(args, "dirichlet_alpha", 0.5),
+            seed=args.seed,
+            num_examples=args.num_examples,
+        ),
+        fed=FedConfig(
+            num_clients=num_clients,
+            num_rounds=getattr(args, "rounds", 20),
+            algorithm=getattr(args, "algorithm", "fedavg"),
+            fedprox_mu=(
+                getattr(args, "fedprox_mu", 0.0)
+                if getattr(args, "algorithm", "fedavg") == "fedprox"
+                else 0.0
+            ),
+            compression=compression,
+            topk_fraction=getattr(args, "topk_fraction", 0.01),
+        ),
+        steps_per_round=steps_per_round,
+    )
+
+
+def compress_enabled(args) -> bool:
+    return str(getattr(args, "compressFlag", "N")).upper() == "Y"

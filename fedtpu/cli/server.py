@@ -1,0 +1,98 @@
+"""``python -m fedtpu.cli.server`` — primary or backup federated server.
+
+Parity with ``python3 server.py`` (``src/server.py:268-301``): ``--p y``
+starts the primary round loop against the client registry; without it the
+process is the backup (watchdog + promotion). The reference hardcodes the
+registry (``src/server.py:281-282``); here it's ``--clients``. Adds what the
+reference lacked: checkpoint/resume of the global model every round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+
+from fedtpu.checkpoint import Checkpointer
+from fedtpu.cli.common import add_fed_flags, add_model_flags, build_config, compress_enabled
+from fedtpu.transport.federation import BackupServer, PrimaryServer, _model_template
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_model_flags(p)
+    add_fed_flags(p)
+    p.add_argument("--p", default="N", help="y = run as primary")
+    p.add_argument("--backupAddress", default="localhost")
+    p.add_argument("--backupPort", default="50060")
+    p.add_argument("--listen", default="localhost:50060",
+                   help="backup bind address (backup role only)")
+    p.add_argument(
+        "--clients",
+        default="localhost:50051,localhost:50052",
+        help="comma-separated client registry (reference default)",
+    )
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("-r", "--resume", action="store_true",
+                   help="resume the global model from the latest checkpoint")
+    p.add_argument("--watchdog-timeout", default=10.0, type=float)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    clients = [c.strip() for c in args.clients.split(",") if c.strip()]
+    cfg = build_config(args, num_clients=len(clients))
+    compress = compress_enabled(args)
+
+    if str(args.p).lower() == "y":
+        primary = PrimaryServer(
+            cfg,
+            clients,
+            backup_address=f"{args.backupAddress}:{args.backupPort}",
+            compress=compress,
+        )
+        ckpt = None
+        start_round = 0
+        if args.checkpoint_dir:
+            ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
+            if args.resume:
+                params, stats = _model_template(primary.model, cfg)
+                latest = ckpt.restore_latest(
+                    {"params": params, "batch_stats": stats}
+                )
+                if latest is not None:
+                    r, tree = latest
+                    primary.params = jax.tree.map(jnp.asarray, tree["params"])
+                    primary.batch_stats = jax.tree.map(
+                        jnp.asarray, tree["batch_stats"]
+                    )
+                    start_round = r + 1
+                    logging.info("resumed global model from round %d", r)
+        for r in range(start_round, cfg.fed.num_rounds):
+            rec = primary.round()
+            logging.info("round %d: %s", r, rec)
+            if ckpt is not None:
+                ckpt.save(r, {"params": primary.params,
+                              "batch_stats": primary.batch_stats})
+        return 0
+
+    backup = BackupServer(
+        cfg, clients, compress=compress, watchdog_timeout=args.watchdog_timeout
+    )
+    server = backup.start(args.listen)
+    logging.info("backup serving on %s", args.listen)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        backup.watchdog.stop()
+        server.stop(0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

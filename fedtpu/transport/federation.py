@@ -27,7 +27,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import grpc
 import jax
@@ -47,7 +47,7 @@ from fedtpu.ft import (
     PrimaryPinger,
     WatchdogRunner,
 )
-from fedtpu.transport import proto, wire
+from fedtpu.transport import proto, sparse, wire
 from fedtpu.transport.service import (
     TrainerServicer,
     TrainerStub,
@@ -113,6 +113,14 @@ class LocalTrainer:
         self.round_idx = 0
         self._local_update = jax.jit(make_local_update(self.model.apply, cfg))
         self._evaluate = make_eval_fn(self.model.apply, cfg)
+        # Sparse-delta mode needs the client's round-start model to equal the
+        # server's global; until the first SendModel lands we fall back to
+        # dense full-weight payloads.
+        self.synced = False
+        # Edge error feedback: mass dropped by top-k is carried locally into
+        # the next round's delta (the host-side analogue of
+        # fedtpu.ops.compression residuals).
+        self.edge_residual = None
 
     def _shard(self, rank: int, world: int):
         """This client's rows of the deterministic ``world``-way partition.
@@ -152,9 +160,10 @@ class LocalTrainer:
             seed=cfg.data.seed + self.round_idx,
         )
         self.rng, step_rng = jax.random.split(self.rng)
+        start_params, start_stats = self.params, self.batch_stats
         out = self._local_update(
-            self.params,
-            self.batch_stats,
+            start_params,
+            start_stats,
             self.opt_state,
             jnp.asarray(x[0]),
             jnp.asarray(y[0]),
@@ -166,18 +175,44 @@ class LocalTrainer:
         self.batch_stats = out.batch_stats
         self.opt_state = out.opt_state
         self.round_idx += 1
+
+        codec = cfg.fed.compression
+        if codec in ("topk", "int8") and self.synced:
+            # Ship the sparse/quantized *delta* — the wire actually shrinks,
+            # unlike the reference's gzip-over-dense (src/server.py:104-107).
+            delta = jax.tree.map(
+                lambda a, b: np.asarray(a) - np.asarray(b),
+                {"params": out.params, "batch_stats": out.batch_stats},
+                {"params": start_params, "batch_stats": start_stats},
+            )
+            extra = {"num_examples": np.float32(num_examples)}
+            ef = cfg.fed.error_feedback
+            encode = (
+                (lambda d, r: sparse.encode_topk(
+                    d, cfg.fed.topk_fraction, residuals=r, extra=extra,
+                    collect_residual=ef))
+                if codec == "topk"
+                else (lambda d, r: sparse.encode_int8(
+                    d, residuals=r, extra=extra, collect_residual=ef))
+            )
+            payload, residual = encode(delta, self.edge_residual if ef else None)
+            if ef:
+                self.edge_residual = residual
+            return payload
+
         payload = {
             "params": out.params,
             "batch_stats": out.batch_stats,
             "num_examples": np.float32(num_examples),
         }
-        return wire.encode(payload, compress=cfg.fed.compression != "none")
+        return wire.encode(payload, compress=codec != "none")
 
     def set_global(self, data: bytes) -> None:
         params, stats = _model_template(self.model, self.cfg)
         tree = wire.decode(data, {"params": params, "batch_stats": stats})
         self.params = jax.tree.map(jnp.asarray, tree["params"])
         self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+        self.synced = True
 
     def evaluate(self) -> Tuple[float, float]:
         bs = self.cfg.data.eval_batch_size
@@ -278,19 +313,20 @@ class PrimaryServer:
         )
         self._aggregate = jax.jit(self._aggregate_impl)
         self.history: List[dict] = []
+        self._did_initial_sync = False
 
     # ----------------------------------------------------------- aggregation
-    def _aggregate_impl(self, stacked, weights):
-        """Masked weighted mean over the stacked client axis — the same math
-        as the simulated engine's aggregator; dead clients never enter the
-        stack so no mask is needed here."""
+    def _aggregate_impl(self, global_tree, stacked_deltas, weights):
+        """global + weighted mean of client deltas over the stacked axis —
+        one jitted program, same math as the simulated engine's aggregator;
+        dead clients never enter the stack so no mask is needed here."""
         total = jnp.maximum(jnp.sum(weights), 1e-9)
 
-        def leaf_mean(x):
-            w = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
-            return jnp.sum(x * w, axis=0) / total.astype(x.dtype)
+        def leaf(g, d):
+            w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+            return g + jnp.sum(d * w, axis=0) / total.astype(d.dtype)
 
-        return jax.tree.map(leaf_mean, stacked)
+        return jax.tree.map(leaf, global_tree, stacked_deltas)
 
     # ------------------------------------------------------------- transport
     def model_bytes(self) -> bytes:
@@ -312,6 +348,25 @@ class PrimaryServer:
             proto.SendModelRequest(model=self.model_bytes()),
             timeout=self.rpc_timeout,
         )
+
+    def sync_clients(self) -> None:
+        """Broadcast the current global model to all active clients.
+
+        Runs automatically before the first round (see :meth:`round`):
+        clients may hold baselines from a previous server generation, and in
+        sparse-delta mode an unsynced baseline would silently corrupt
+        aggregation.
+        """
+        payload = self.model_bytes()
+        for client in self.registry.active_clients():
+            try:
+                self._stubs[client].SendModel(
+                    proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
+                )
+            except grpc.RpcError:
+                log.warning("client %s failed during initial sync", client)
+                self.registry.mark_failed(client)
+        self._did_initial_sync = True
 
     def _ping_backup(self, recovering: bool) -> Optional[int]:
         try:
@@ -338,10 +393,40 @@ class PrimaryServer:
     # ------------------------------------------------------------ round loop
     def round(self) -> dict:
         cfg = self.cfg
+        if not self._did_initial_sync:
+            self.sync_clients()
         active = self.registry.active_clients()
         world = len(self.registry.clients)
-        template = _payload_template(self.model, cfg)
-        results: Dict[str, dict] = {}
+        # Host copies of the global model are only needed for dense replies /
+        # sparse templates; build them lazily (in topk steady state the full
+        # device->host transfer would otherwise run every round for nothing).
+        cache: Dict[str, Any] = {}
+        cache_lock = threading.Lock()
+
+        def global_host():
+            with cache_lock:
+                if "g" not in cache:
+                    cache["g"] = {
+                        "params": jax.tree.map(np.asarray, self.params),
+                        "batch_stats": jax.tree.map(np.asarray, self.batch_stats),
+                    }
+                return cache["g"]
+
+        def delta_template():
+            with cache_lock:
+                if "d" not in cache:
+                    cache["d"] = {
+                        "params": jax.tree.map(
+                            lambda s: np.zeros(s.shape, s.dtype), self.params
+                        ),
+                        "batch_stats": jax.tree.map(
+                            lambda s: np.zeros(s.shape, s.dtype), self.batch_stats
+                        ),
+                    }
+                return cache["d"]
+
+        # results[client] = (delta_tree, num_examples)
+        results: Dict[str, tuple] = {}
 
         def train_one(rank: int, client: str) -> None:
             try:
@@ -349,7 +434,23 @@ class PrimaryServer:
                     proto.TrainRequest(rank=rank, world=world),
                     timeout=self.rpc_timeout,
                 )
-                results[client] = wire.decode(reply.message, template)
+                data = reply.message
+                if sparse.is_sparse_payload(data):
+                    deltas, extra = sparse.decode(data, delta_template())
+                    results[client] = (deltas, float(extra["num_examples"]))
+                else:
+                    tree = wire.decode(
+                        data, _payload_template(self.model, cfg)
+                    )
+                    # Dense full weights -> delta against the round's global,
+                    # so dense and sparse replies aggregate uniformly.
+                    delta = jax.tree.map(
+                        lambda a, g: np.asarray(a) - g,
+                        {"params": tree["params"],
+                         "batch_stats": tree["batch_stats"]},
+                        global_host(),
+                    )
+                    results[client] = (delta, float(tree["num_examples"]))
             except grpc.RpcError as e:
                 log.warning(
                     "client %s failed during StartTrain: %s %s",
@@ -370,23 +471,21 @@ class PrimaryServer:
             order = [c for c in active if c in results]
             stacked = jax.tree.map(
                 lambda *leaves: jnp.stack(leaves),
-                *[
-                    {
-                        "params": results[c]["params"],
-                        "batch_stats": results[c]["batch_stats"],
-                    }
-                    for c in order
-                ],
+                *[results[c][0] for c in order],
             )
             if cfg.fed.weighted:
                 weights = jnp.asarray(
-                    [float(results[c]["num_examples"]) for c in order], jnp.float32
+                    [results[c][1] for c in order], jnp.float32
                 )
             else:
                 weights = jnp.ones((len(order),), jnp.float32)
-            mean = self._aggregate(stacked, weights)
-            self.params = mean["params"]
-            self.batch_stats = mean["batch_stats"]
+            new_global = self._aggregate(
+                {"params": self.params, "batch_stats": self.batch_stats},
+                stacked,
+                weights,
+            )
+            self.params = new_global["params"]
+            self.batch_stats = new_global["batch_stats"]
 
         payload = self.model_bytes()
         # Backup first (parity: replication before client broadcast,
@@ -443,6 +542,9 @@ class PrimaryServer:
             # demotion + model fetch must land before we train round 0.
             self.pinger.tick()
             self.pinger.start()
+        # The first round() call broadcasts the global model before training
+        # (see sync_clients) — after the pinger tick above, so a model
+        # fetched from a demoting backup is what gets synced.
         try:
             for r in range(num_rounds):
                 if stop is not None and stop():

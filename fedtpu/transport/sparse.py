@@ -1,0 +1,201 @@
+"""Sparse/quantized *delta* payloads for the DCN edge.
+
+The reference's ``-c Y`` gzips a base64 dense checkpoint — the wire still
+carries every parameter (``src/server.py:104-107``). When fedtpu's delta
+compression is on, the distributed edge ships what the codec actually kept:
+top-k ``(indices, values)`` pairs or int8 codes + scale per leaf, framed and
+CRC-checked like :mod:`fedtpu.transport.wire` (magic ``FSP1`` vs the dense
+format's ``FTP1``, so a receiver can dispatch on the first 4 bytes).
+
+Wire size: top-k at fraction f costs ~``8 * f * n`` bytes (int32 idx + f32
+val) vs ``4n`` dense — a 50x reduction at f=0.01; int8 costs ``n`` bytes —
+4x. Encoding uses the native codec (:mod:`fedtpu.native`) when built.
+
+Payloads are self-describing msgpack (no template needed to decode — nnz
+varies per round), with leaf order = ``jax.tree_util.tree_flatten`` order of
+the delta pytree, which both ends derive from the same model definition.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+import zlib
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from fedtpu.native import (
+    dequant_int8,
+    kth_magnitude,
+    pack_sparse,
+    pack_sparse_with_residual,
+    quant_int8,
+    unpack_sparse,
+)
+from fedtpu.transport.wire import WireError
+
+Pytree = Any
+
+_MAGIC = b"FSP1"
+_VERSION = 1
+_HEADER = struct.Struct("<4sBBI")
+
+
+def is_sparse_payload(data: bytes) -> bool:
+    return data[:4] == _MAGIC
+
+
+def _frame(payload: bytes) -> bytes:
+    return _HEADER.pack(
+        _MAGIC, _VERSION, 0, zlib.crc32(payload) & 0xFFFFFFFF
+    ) + payload
+
+
+def _unframe(data: bytes) -> bytes:
+    if len(data) < _HEADER.size or data[:4] != _MAGIC:
+        raise WireError("not a fedtpu sparse payload")
+    _, version, _, crc = _HEADER.unpack_from(data)
+    if version != _VERSION:
+        raise WireError(f"unsupported sparse wire version {version}")
+    payload = data[_HEADER.size :]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("sparse payload CRC mismatch")
+    return payload
+
+
+def encode_topk(
+    deltas: Pytree,
+    fraction: float,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = True,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Sparsify a delta pytree to wire bytes; returns (payload, residuals).
+
+    ``residuals`` (same structure) are added to the deltas before selection
+    and replaced by the dropped mass — client-side error feedback, the edge
+    analogue of :mod:`fedtpu.ops.compression`. With
+    ``collect_residual=False`` (error feedback off) no residual tree is
+    materialised and None is returned in its place.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else [None] * len(leaves)
+    )
+    out_leaves, new_res = [], []
+    for leaf, res in zip(leaves, res_leaves):
+        x = np.asarray(leaf, np.float32).ravel()
+        if res is not None:
+            x = x + np.asarray(res, np.float32).ravel()
+        k = max(1, int(math.ceil(fraction * x.size)))
+        thresh = kth_magnitude(x, k)
+        if thresh == 0.0:
+            # Degenerate all-(near-)zero leaf: |x| >= 0 would "keep" every
+            # element, making the sparse form 2x dense. Keep only true
+            # nonzeros; the residual is exactly zero.
+            idx = np.flatnonzero(x).astype(np.int32)
+            vals = x[idx]
+            residual = np.zeros_like(x) if collect_residual else None
+        elif collect_residual:
+            idx, vals, residual = pack_sparse_with_residual(x, thresh)
+        else:
+            idx, vals = pack_sparse(x, thresh)
+            residual = None
+        out_leaves.append(
+            {"idx": idx, "vals": vals, "size": np.int64(x.size)}
+        )
+        if collect_residual:
+            new_res.append(residual.reshape(np.shape(leaf)))
+    body = {
+        "kind": "topk",
+        "leaves": {str(i): l for i, l in enumerate(out_leaves)},
+        "extra": extra or {},
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    residual_tree = (
+        jax.tree_util.tree_unflatten(treedef, new_res)
+        if collect_residual
+        else None
+    )
+    return payload, residual_tree
+
+
+def encode_int8(
+    deltas: Pytree,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = False,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Quantize a delta pytree to wire bytes; returns (payload, residuals).
+
+    With ``collect_residual=True`` the per-round quantization error
+    (``input - dequant(quant(input))``) is returned for error feedback,
+    matching the simulated engine's int8 codec semantics
+    (:func:`fedtpu.ops.compression.make_int8`).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else [None] * len(leaves)
+    )
+    out, new_res = [], []
+    for leaf, res in zip(leaves, res_leaves):
+        x = np.asarray(leaf, np.float32).ravel()
+        if res is not None:
+            x = x + np.asarray(res, np.float32).ravel()
+        codes, scale = quant_int8(x)
+        out.append(
+            {"codes": codes, "scale": np.float32(scale), "size": np.int64(x.size)}
+        )
+        if collect_residual:
+            back = dequant_int8(codes, scale, x.size)
+            new_res.append((x - back).reshape(np.shape(leaf)))
+    body = {
+        "kind": "int8",
+        "leaves": {str(i): l for i, l in enumerate(out)},
+        "extra": extra or {},
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    residual_tree = (
+        jax.tree_util.tree_unflatten(treedef, new_res)
+        if collect_residual
+        else None
+    )
+    return payload, residual_tree
+
+
+def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
+    """Reconstruct a dense delta pytree shaped like ``like``; returns
+    (deltas, extra)."""
+    body = serialization.msgpack_restore(_unframe(data))
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(body["leaves"]) != len(leaves):
+        raise WireError(
+            f"sparse payload has {len(body['leaves'])} leaves, template has "
+            f"{len(leaves)}"
+        )
+    enc = [body["leaves"][str(i)] for i in range(len(leaves))]
+    out = []
+    for leaf, e in zip(leaves, enc):
+        n = int(e["size"])
+        if n != np.size(leaf):
+            raise WireError("sparse leaf size mismatch with template")
+        if body["kind"] == "topk":
+            idx = np.ascontiguousarray(e["idx"], np.int32)
+            # Wire data is untrusted: the native scatter writes out[idx[i]]
+            # unchecked, so out-of-range indices would be a heap write.
+            if idx.size and (idx.min() < 0 or idx.max() >= n):
+                raise WireError("sparse index out of range")
+            dense = unpack_sparse(idx, e["vals"], n)
+        elif body["kind"] == "int8":
+            dense = dequant_int8(e["codes"], float(e["scale"]), n)
+        else:
+            raise WireError(f"unknown sparse kind {body['kind']!r}")
+        out.append(dense.reshape(np.shape(leaf)).astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, out), dict(body.get("extra", {}))

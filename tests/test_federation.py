@@ -116,6 +116,52 @@ def test_heartbeat_revives_and_resyncs(two_clients):
     assert primary.registry.alive_mask().tolist() == [True, True]
 
 
+def test_sparse_compressed_federation_learns():
+    """-c Y parity, upgraded: clients ship top-k sparse deltas (after the
+    initial sync), the server reconstructs and aggregates them, and the
+    federation still learns."""
+    import dataclasses
+
+    from fedtpu.config import FedConfig
+
+    cfg = tiny_cfg()
+    cfg = dataclasses.replace(
+        cfg,
+        fed=FedConfig(num_clients=2, num_rounds=2, compression="topk",
+                      topk_fraction=0.25),
+    )
+    addrs, servers, agents = [], [], []
+    try:
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, agent = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+            agents.append(agent)
+        primary = PrimaryServer(cfg, addrs)
+        primary.sync_clients()  # run() does this; round() alone needs it
+        assert all(a.trainer.synced for a in agents)
+        for _ in range(6):
+            rec = primary.round()
+            assert rec["participants"] == 2
+        # Sparse mode engaged: clients now hold edge residuals.
+        assert agents[0].trainer.edge_residual is not None
+        accs = [agent.last_eval[1] for agent in agents]
+        assert max(accs) > 0.5, accs
+        # And the sparse payload is much smaller than the dense one.
+        dense = len(primary.model_bytes())
+        sparse_payload = agents[0].trainer.train_round(0, 2)
+        from fedtpu.transport import sparse as sparse_mod
+
+        assert sparse_mod.is_sparse_payload(sparse_payload)
+        # topk at fraction f costs ~8f bytes/param (idx+val) vs 4 dense:
+        # f=0.25 -> ~half the dense size (+ small ties/header slack).
+        assert len(sparse_payload) < dense * 0.55
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
 def test_model_replicates_to_backup(two_clients):
     cfg, addrs, agents = two_clients
     backup_addr = f"localhost:{free_port()}"

@@ -1,0 +1,162 @@
+"""Sparse delta wire payloads (fedtpu.transport.sparse)."""
+
+import numpy as np
+import pytest
+
+from fedtpu.transport import sparse
+from fedtpu.transport.wire import WireError
+
+
+def delta_tree(rng):
+    return {
+        "params": {
+            "w": rng.normal(size=(32, 16)).astype(np.float32),
+            "b": rng.normal(size=(16,)).astype(np.float32),
+        },
+        "batch_stats": {"mean": rng.normal(size=(16,)).astype(np.float32)},
+    }
+
+
+def zeros_like_tree(tree):
+    import jax
+
+    return jax.tree.map(np.zeros_like, tree)
+
+
+def test_topk_roundtrip_keeps_largest(rng):
+    tree = delta_tree(rng)
+    payload, residual = sparse.encode_topk(
+        tree, fraction=0.1, extra={"num_examples": np.float32(7)}
+    )
+    assert sparse.is_sparse_payload(payload)
+    out, extra = sparse.decode(payload, zeros_like_tree(tree))
+    assert float(extra["num_examples"]) == 7
+    w, out_w = tree["params"]["w"].ravel(), out["params"]["w"].ravel()
+    nnz = np.count_nonzero(out_w)
+    assert 0.05 * w.size <= nnz <= 0.2 * w.size
+    kept = np.abs(w[out_w != 0])
+    dropped = np.abs(w[out_w == 0])
+    assert kept.min() >= dropped.max() - 1e-6
+    # Residual is the dropped mass: kept + residual == input.
+    import jax
+
+    for o, r, x in zip(
+        jax.tree.leaves(out), jax.tree.leaves(residual), jax.tree.leaves(tree)
+    ):
+        np.testing.assert_allclose(o + r, x, atol=1e-6)
+
+
+def test_topk_error_feedback_carries(rng):
+    tree = delta_tree(rng)
+    p1, res1 = sparse.encode_topk(tree, fraction=0.05)
+    # Second round with residuals: selection sees delta + residual.
+    p2, res2 = sparse.encode_topk(tree, fraction=0.05, residuals=res1)
+    out2, _ = sparse.decode(p2, zeros_like_tree(tree))
+    import jax
+
+    for o, r2, x, r1 in zip(
+        jax.tree.leaves(out2),
+        jax.tree.leaves(res2),
+        jax.tree.leaves(tree),
+        jax.tree.leaves(res1),
+    ):
+        np.testing.assert_allclose(o + r2, x + r1, atol=1e-6)
+
+
+def test_int8_roundtrip_error_bound(rng):
+    tree = delta_tree(rng)
+    payload, residual = sparse.encode_int8(
+        tree, extra={"num_examples": np.float32(3)}
+    )
+    assert residual is None  # collect_residual defaults off
+    out, extra = sparse.decode(payload, zeros_like_tree(tree))
+    assert float(extra["num_examples"]) == 3
+    import jax
+
+    for o, x in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+        scale = np.abs(x).max() / 127.0
+        assert np.abs(o - x).max() <= scale / 2 + 1e-7
+
+
+def test_int8_error_feedback_residuals(rng):
+    tree = delta_tree(rng)
+    payload, res = sparse.encode_int8(tree, collect_residual=True)
+    out, _ = sparse.decode(payload, zeros_like_tree(tree))
+    import jax
+
+    # residual == input - dequant(quant(input)), so out + residual == input.
+    for o, r, x in zip(
+        jax.tree.leaves(out), jax.tree.leaves(res), jax.tree.leaves(tree)
+    ):
+        np.testing.assert_allclose(o + r, x, atol=1e-6)
+
+
+def test_topk_zero_leaf_stays_small(rng):
+    """An all-zero leaf must encode as ~empty, not as n explicit zeros."""
+    tree = {
+        "w": rng.normal(size=(64, 64)).astype(np.float32),
+        "frozen": np.zeros((512, 512), np.float32),
+    }
+    payload, res = sparse.encode_topk(tree, fraction=0.25)
+    out, _ = sparse.decode(payload, zeros_like_tree(tree))
+    assert not out["frozen"].any()
+    # Far below the 8-bytes-per-entry cost of encoding the frozen leaf dense.
+    assert len(payload) < tree["frozen"].size
+    np.testing.assert_array_equal(res["frozen"], 0.0)
+
+
+def test_topk_no_residual_when_disabled(rng):
+    tree = delta_tree(rng)
+    payload, res = sparse.encode_topk(tree, fraction=0.1, collect_residual=False)
+    assert res is None
+    out, _ = sparse.decode(payload, zeros_like_tree(tree))
+    assert any(np.count_nonzero(l) for l in out["params"].values())
+
+
+def test_decode_rejects_out_of_range_indices(rng):
+    """Malicious/corrupt indices must raise, not scatter out of bounds."""
+    from flax import serialization
+
+    tree = {"w": np.zeros((16,), np.float32)}
+    body = {
+        "kind": "topk",
+        "leaves": {"0": {"idx": np.array([99], np.int32),
+                         "vals": np.array([1.0], np.float32),
+                         "size": np.int64(16)}},
+        "extra": {},
+    }
+    payload = sparse._frame(serialization.msgpack_serialize(body))
+    with pytest.raises(WireError):
+        sparse.decode(payload, tree)
+    body["leaves"]["0"]["idx"] = np.array([-1], np.int32)
+    payload = sparse._frame(serialization.msgpack_serialize(body))
+    with pytest.raises(WireError):
+        sparse.decode(payload, tree)
+
+
+def test_sparse_wire_size_shrinks(rng):
+    big = {"w": rng.normal(size=(512, 512)).astype(np.float32)}
+    from fedtpu.transport import wire
+
+    dense = wire.encode(big)
+    topk, _ = sparse.encode_topk(big, fraction=0.01)
+    int8, _ = sparse.encode_int8(big)
+    assert len(topk) < len(dense) / 20
+    assert len(int8) < len(dense) / 3
+
+
+def test_sparse_rejects_corruption(rng):
+    tree = delta_tree(rng)
+    payload, _ = sparse.encode_topk(tree, fraction=0.1)
+    bad = bytearray(payload)
+    bad[-2] ^= 0x40
+    with pytest.raises(WireError):
+        sparse.decode(bytes(bad), zeros_like_tree(tree))
+
+
+def test_sparse_rejects_template_mismatch(rng):
+    tree = delta_tree(rng)
+    payload, _ = sparse.encode_topk(tree, fraction=0.1)
+    wrong = {"params": {"w": np.zeros((4, 4), np.float32)}}
+    with pytest.raises(WireError):
+        sparse.decode(payload, wrong)

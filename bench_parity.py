@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""The 5 parity configs from BASELINE.md, end to end.
+
+Each config runs through the simulated engine (the TPU-native path) and
+reports rounds/sec + accuracies as one JSON line per config. ``--quick``
+shrinks datasets/rounds for smoke runs on CPU; the full mode is sized for the
+real chip. The reference publishes no numbers (BASELINE.md), so these are the
+framework-side columns of the parity table.
+
+Reference round semantics are preserved: one round = every client trains its
+shard for `local_epochs` epochs (folded into steps_per_round), then one
+weighted aggregation.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core import Federation
+from fedtpu.data import load
+
+
+_TRAIN_SIZE = {"mnist": 60000, "cifar10": 50000, "cifar100": 50000}
+
+
+def configs(quick: bool):
+    # Quick mode is a CPU smoke pass: tiny data, batch 16, augmentation off,
+    # client counts /16, a couple of steps per round — it checks the configs
+    # *run*, not their numbers. Full mode preserves the reference's round
+    # semantics: one round = `local_epochs` full passes over the client's
+    # shard (steps_per_round computed from dataset size / clients / batch).
+    n = 512 if quick else None  # dataset truncation
+    rounds = 4 if quick else 20
+    scale = 16 if quick else 1
+
+    def mk(name, model, dataset, clients, quick_steps, partition="iid",
+           local_epochs=1, **fed_kw):
+        data_kw = {}
+        if partition == "dirichlet":
+            data_kw["dirichlet_alpha"] = 0.5
+        clients = max(2, clients // scale)
+        batch = 16 if quick else 128
+        if quick:
+            steps = max(1, quick_steps // 2)
+        else:
+            shard = _TRAIN_SIZE[dataset] // clients
+            steps = max(1, math.ceil(shard / batch)) * local_epochs
+        return name, RoundConfig(
+            model=model,
+            num_classes=100 if dataset == "cifar100" else 10,
+            opt=OptimizerConfig(learning_rate=0.05),
+            data=DataConfig(
+                dataset=dataset,
+                batch_size=batch,
+                partition=partition,
+                num_examples=n,
+                augment=not quick,
+                **data_kw,
+            ),
+            fed=FedConfig(num_clients=clients, num_rounds=rounds, **fed_kw),
+            steps_per_round=steps,
+        )
+
+    yield mk("1_fedavg_mlp_mnist_2c_iid", "mlp", "mnist", 2, 4)
+    yield mk("2_fedavg_cnn_cifar10_8c_dirichlet", "smallcnn", "cifar10", 8, 4,
+             partition="dirichlet")
+    yield mk("3_fedprox_cnn_cifar10_32c", "smallcnn", "cifar10", 32, 2,
+             algorithm="fedprox", fedprox_mu=0.01)
+    # Config 4 is "5 local epochs": steps_per_round covers the whole shard
+    # 5x (the engine folds local epochs into steps, fedtpu/core/engine.py).
+    # Quick mode swaps resnet18 -> smallcnn: XLA's CPU compile of the vmapped
+    # resnet18 train step alone takes ~10 min, which defeats a smoke pass
+    # (the zoo tests cover resnet18 correctness separately).
+    yield mk("4_fedavg_resnet18_cifar100_64c_5ep",
+             "smallcnn" if quick else "resnet18", "cifar100", 64, 5,
+             local_epochs=5)
+    yield mk("5_topk_compressed_fedavg_128c", "smallcnn", "cifar10", 128, 2,
+             compression="topk", topk_fraction=0.01)
+
+
+def run_one(name: str, cfg: RoundConfig) -> dict:
+    fed = Federation(cfg, seed=0)
+    test = load(cfg.data.dataset, "test", seed=cfg.data.seed,
+                num=cfg.data.num_examples)
+    # Warmup (compile) round, then timed rounds with a forced host sync.
+    m = fed.step()
+    float(m.loss)
+    t0 = time.perf_counter()
+    for _ in range(cfg.fed.num_rounds - 1):
+        m = fed.step()
+        float(m.loss)
+    dt = time.perf_counter() - t0
+    test_loss, test_acc = fed.evaluate(*test)
+    return {
+        "config": name,
+        "rounds_per_sec": round((cfg.fed.num_rounds - 1) / max(dt, 1e-9), 3),
+        "train_acc": round(float(m.accuracy), 4),
+        "test_acc": round(test_acc, 4),
+        "num_clients": cfg.fed.num_clients,
+        "model": cfg.model,
+        "dataset": cfg.data.dataset,
+        "algorithm": cfg.fed.algorithm,
+        "compression": cfg.fed.compression,
+        "devices": len(jax.devices()),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="small data/rounds for CPU smoke runs")
+    p.add_argument("--only", default=None,
+                   help="substring filter on config names")
+    args = p.parse_args()
+    for name, cfg in configs(args.quick):
+        if args.only and args.only not in name:
+            continue
+        print(json.dumps(run_one(name, cfg)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

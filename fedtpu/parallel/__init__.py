@@ -4,6 +4,7 @@ from fedtpu.parallel.sharded import (
     shard_batch,
     shard_state,
 )
+from fedtpu.parallel.dryrun import dryrun_multichip
 
 __all__ = [
     "client_mesh",
@@ -12,4 +13,5 @@ __all__ = [
     "make_sharded_round_step",
     "shard_batch",
     "shard_state",
+    "dryrun_multichip",
 ]

@@ -30,6 +30,10 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
     p.add_argument("-r", "--resume", action="store_true")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the rounds here")
+    p.add_argument("--progress", action="store_true",
+                   help="per-round progress bar (headless-safe)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -54,23 +58,34 @@ def main(argv=None) -> int:
                 fed.state = jax.tree.map(jnp.asarray, state)
                 logging.info("resumed from round %d", start_round)
 
-    logger = MetricsLogger(path=args.metrics)
+    logger = MetricsLogger(path=args.metrics, echo=not args.progress)
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
     )
+    from fedtpu.utils.progress import ProgressBar, profile_rounds
+
+    bar = (
+        ProgressBar(cfg.fed.num_rounds - start_round) if args.progress else None
+    )
     t0 = time.time()
-    for r in range(start_round, cfg.fed.num_rounds):
-        metrics = fed.step()
-        rec = {
-            "loss": float(metrics.loss),
-            "acc": float(metrics.accuracy),
-            "active": float(metrics.num_active),
-        }
-        if args.eval_every and (r + 1) % args.eval_every == 0:
-            rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
-        logger.log(r, **rec)
-        if ckpt is not None and (r + 1) % args.checkpoint_every == 0:
-            ckpt.save(r + 1, fed.state)
+    with profile_rounds(args.profile_dir):
+        for r in range(start_round, cfg.fed.num_rounds):
+            metrics = fed.step()
+            rec = {
+                "loss": float(metrics.loss),
+                "acc": float(metrics.accuracy),
+                "active": float(metrics.num_active),
+            }
+            if args.eval_every and (r + 1) % args.eval_every == 0:
+                rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
+            logger.log(r, **rec)
+            if bar is not None:
+                msg = f"loss {rec['loss']:.3f} acc {rec['acc']:.3f}"
+                if "test_acc" in rec:
+                    msg += f" test_acc {rec['test_acc']:.3f}"
+                bar.update(r - start_round, msg)
+            if ckpt is not None and (r + 1) % args.checkpoint_every == 0:
+                ckpt.save(r + 1, fed.state)
     dt = time.time() - t0
     done = cfg.fed.num_rounds - start_round
     logging.info(

@@ -64,11 +64,10 @@ class Federation:
             from fedtpu.ops.compression import make_compressor
 
             compressor = make_compressor(cfg.fed)
-        if cfg.fed.local_epochs != 1:
-            raise NotImplementedError(
-                "local_epochs != 1: fold extra epochs into steps_per_round "
-                "(steps_per_round = local_epochs * shard_batches)"
-            )
+        # local_epochs folds into the per-round step count: one epoch is
+        # steps_per_round passes over the shard (make_client_batches wraps
+        # short shards), matching the reference's epochs-per-StartTrain knob.
+        self._steps = cfg.steps_per_round * max(1, cfg.fed.local_epochs)
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
 
         if data is None:
@@ -116,7 +115,7 @@ class Federation:
             self.client_idx,
             self.client_mask,
             cfg.data.batch_size,
-            cfg.steps_per_round,
+            self._steps,
             seed=cfg.data.seed + round_idx,
             shuffle=cfg.data.partition != "round_robin",
         )

@@ -5,8 +5,10 @@ from fedtpu.parallel.sharded import (
     shard_state,
 )
 from fedtpu.parallel.dryrun import dryrun_multichip
+from fedtpu.parallel import multihost
 
 __all__ = [
+    "multihost",
     "client_mesh",
     "client_sharded",
     "replicated",

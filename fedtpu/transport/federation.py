@@ -427,6 +427,7 @@ class PrimaryServer:
 
         # results[client] = (delta_tree, num_examples)
         results: Dict[str, tuple] = {}
+        bytes_up = [0]  # client -> server payload bytes this round
 
         def train_one(rank: int, client: str) -> None:
             try:
@@ -435,6 +436,8 @@ class PrimaryServer:
                     timeout=self.rpc_timeout,
                 )
                 data = reply.message
+                with cache_lock:
+                    bytes_up[0] += len(data)
                 if sparse.is_sparse_payload(data):
                     deltas, extra = sparse.decode(data, delta_template())
                     results[client] = (deltas, float(extra["num_examples"]))
@@ -488,6 +491,7 @@ class PrimaryServer:
             self.batch_stats = new_global["batch_stats"]
 
         payload = self.model_bytes()
+        bytes_down = [0]  # only successful sends count
         # Backup first (parity: replication before client broadcast,
         # src/server.py:141-153).
         if self.backup_stub is not None:
@@ -495,6 +499,7 @@ class PrimaryServer:
                 self.backup_stub.SendModel(
                     proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
                 )
+                bytes_down[0] += len(payload)
             except grpc.RpcError:
                 log.warning("backup unreachable during replication")
 
@@ -503,6 +508,8 @@ class PrimaryServer:
                 self._stubs[client].SendModel(
                     proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
                 )
+                with cache_lock:
+                    bytes_down[0] += len(payload)
             except grpc.RpcError as e:
                 log.warning(
                     "client %s failed during SendModel: %s %s",
@@ -523,6 +530,11 @@ class PrimaryServer:
             "participants": len(results),
             "world": world,
             "alive": self.registry.alive_mask().tolist(),
+            # Wire accounting (successful transfers only) — the reference
+            # can't report this at all; its payloads are opaque base64 blobs
+            # (src/client.py:21).
+            "bytes_up": bytes_up[0],
+            "bytes_down": bytes_down[0],
         }
         self.history.append(rec)
         return rec

@@ -1,4 +1,11 @@
 from fedtpu.utils import trees
 from fedtpu.utils.metrics import MetricsLogger, format_time
+from fedtpu.utils.progress import ProgressBar, profile_rounds
 
-__all__ = ["trees", "MetricsLogger", "format_time"]
+__all__ = [
+    "trees",
+    "MetricsLogger",
+    "format_time",
+    "ProgressBar",
+    "profile_rounds",
+]

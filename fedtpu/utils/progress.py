@@ -1,0 +1,67 @@
+"""Headless-safe progress rendering + profiling hooks.
+
+The reference's ``progress_bar`` (``src/utils.py:51-92``) reads the terminal
+width via ``stty size`` at *import* time and crashes headless runs; this one
+probes lazily, falls back to 80 columns, and degrades to plain line logging
+when stdout isn't a tty. ``profile_rounds`` wraps a block in
+``jax.profiler.trace`` so a round loop can be profiled with one flag
+(``fedtpu.cli.run --profile-dir``) — the subsystem the reference lacks
+entirely (SURVEY §5: tracing "minimal").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import shutil
+import sys
+import time
+from typing import Iterator, Optional
+
+from fedtpu.utils.metrics import format_time
+
+
+class ProgressBar:
+    """Per-step progress with loss/acc readout (parity: ``progress_bar``,
+    ``src/utils.py:51-92``, minus the tty landmines)."""
+
+    def __init__(self, total: int, width: Optional[int] = None, out=None):
+        self.total = total
+        self.out = out or sys.stderr
+        self._tty = hasattr(self.out, "isatty") and self.out.isatty()
+        cols = width or (shutil.get_terminal_size((80, 24)).columns if self._tty else 80)
+        self.bar_width = max(10, min(40, cols - 45))
+        self.t0 = time.time()
+        self.last = self.t0
+
+    def update(self, step: int, msg: str = "") -> None:
+        now = time.time()
+        step_time, self.last = now - self.last, now
+        done = int(self.bar_width * (step + 1) / self.total)
+        line = (
+            f" [{'=' * done}{'.' * (self.bar_width - done)}] "
+            f"{step + 1}/{self.total} "
+            f"step {format_time(step_time)} tot {format_time(now - self.t0)}"
+        )
+        if msg:
+            line += " | " + msg
+        if self._tty:
+            self.out.write("\r" + line[: shutil.get_terminal_size((80, 24)).columns - 1])
+            if step + 1 >= self.total:
+                self.out.write("\n")
+        else:
+            self.out.write(line + "\n")
+        self.out.flush()
+
+
+@contextlib.contextmanager
+def profile_rounds(trace_dir: Optional[str]) -> Iterator[None]:
+    """``with profile_rounds("/tmp/trace"):`` captures an XLA/TPU profile of
+    the enclosed rounds (viewable in TensorBoard/XProf); no-op when
+    ``trace_dir`` is None."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

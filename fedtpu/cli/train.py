@@ -1,0 +1,46 @@
+"""``python -m fedtpu.cli.train`` — standalone single-node training.
+
+Parity with the reference's original trainer surface (``src/main.py``:
+``--lr``, ``-r/--resume``, per-epoch test with best-accuracy checkpointing,
+cosine schedule) without its import-time side effects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from fedtpu.cli.common import add_model_flags, build_config
+from fedtpu.core.solo import run_solo
+from fedtpu.utils.metrics import MetricsLogger
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_model_flags(p)
+    p.add_argument("--epochs", default=200, type=int,
+                   help="training epochs (reference cosine T_max=200)")
+    p.add_argument("--checkpoint", default="./checkpoint/solo.fckpt",
+                   help="best-accuracy checkpoint path")
+    p.add_argument("-r", "--resume", action="store_true")
+    p.add_argument("--metrics", default=None, help="JSONL metrics path")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    cfg = build_config(args, num_clients=1)
+    trainer = run_solo(
+        cfg,
+        epochs=args.epochs,
+        seed=args.seed,
+        checkpoint_path=args.checkpoint,
+        resume=args.resume,
+        logger=MetricsLogger(path=args.metrics),
+    )
+    logging.info("best test accuracy: %.4f", trainer.best_acc)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

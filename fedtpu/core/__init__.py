@@ -7,8 +7,11 @@ from fedtpu.core.round import (
     make_round_step,
 )
 from fedtpu.core.client import make_eval_fn, make_local_update
+from fedtpu.core.solo import SoloTrainer, run_solo
 
 __all__ = [
+    "SoloTrainer",
+    "run_solo",
     "Federation",
     "FederatedState",
     "RoundBatch",

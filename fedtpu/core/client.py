@@ -138,6 +138,25 @@ def make_local_update(
     return local_update
 
 
+def batch_eval_arrays(images, labels, batch_size: int):
+    """Shape an eval set into ``[num_batches, batch, ...]`` for the jitted
+    evaluator, dropping the ragged tail. Raises (rather than mis-reshaping)
+    when the set is smaller than one batch."""
+    import numpy as np
+
+    nb = len(images) // batch_size
+    if nb == 0:
+        raise ValueError(
+            f"eval set of {len(images)} examples is smaller than "
+            f"eval_batch_size={batch_size}"
+        )
+    xs = np.asarray(images[: nb * batch_size]).reshape(
+        (nb, batch_size) + images.shape[1:]
+    )
+    ys = np.asarray(labels[: nb * batch_size]).reshape((nb, batch_size))
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
 def make_eval_fn(apply_fn: Callable, cfg: RoundConfig) -> Callable:
     """Batched evaluation of a model snapshot (parity: ``src/main.py:167-191``,
     the eval the reference runs on every client after each SendModel)."""

@@ -178,15 +178,9 @@ class Federation:
     # ----------------------------------------------------------------- eval
     def evaluate(self, images: np.ndarray, labels: np.ndarray):
         """Evaluate the current global model (parity: ``src/main.py:167-191``)."""
-        bs = self.cfg.data.eval_batch_size
-        nb = len(images) // bs
-        if nb == 0:
-            raise ValueError(
-                f"eval set of {len(images)} examples is smaller than "
-                f"eval_batch_size={bs}"
-            )
-        xs = jnp.asarray(images[: nb * bs]).reshape((nb, bs) + images.shape[1:])
-        ys = jnp.asarray(labels[: nb * bs]).reshape((nb, bs))
+        from fedtpu.core.client import batch_eval_arrays
+
+        xs, ys = batch_eval_arrays(images, labels, self.cfg.data.eval_batch_size)
         loss, acc = self._evaluate(self.state.params, self.state.batch_stats, xs, ys)
         return float(loss), float(acc)
 

@@ -52,10 +52,27 @@ def initialize(
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError:
-        if kwargs:
+        if kwargs or _cluster_env_detected():
+            # Explicit args, or a cluster environment that *should* have
+            # worked: silently degrading to N independent single-host runs
+            # (each believing it is the coordinator) would be far worse than
+            # failing here.
             raise
         # Env auto-detection found no cluster (single host, no pod
         # environment): multi-controller setup simply isn't needed.
+
+
+def _cluster_env_detected() -> bool:
+    import os
+
+    return any(
+        os.environ.get(k)
+        for k in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+    )
 
 
 def _already_initialized() -> bool:

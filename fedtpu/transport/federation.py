@@ -149,7 +149,11 @@ class LocalTrainer:
         cfg = self.cfg
         own, own_mask = self._shard(rank, world)
         num_examples = float(own_mask.sum())
-        steps = max(1, int(own_mask[0].sum()) // cfg.data.batch_size)
+        # One epoch = the shard's batch count; local_epochs multiplies it
+        # (same fold as the simulated engine, fedtpu/core/engine.py).
+        steps = max(1, int(own_mask[0].sum()) // cfg.data.batch_size) * max(
+            1, cfg.fed.local_epochs
+        )
         x, y, step_mask = partition.make_client_batches(
             self.images,
             self.labels,

@@ -1,6 +1,7 @@
 from fedtpu.utils import trees
 from fedtpu.utils.metrics import MetricsLogger, format_time
 from fedtpu.utils.progress import ProgressBar, profile_rounds
+from fedtpu.utils.stats import get_mean_and_std, kaiming_init_params
 
 __all__ = [
     "trees",
@@ -8,4 +9,6 @@ __all__ = [
     "format_time",
     "ProgressBar",
     "profile_rounds",
+    "get_mean_and_std",
+    "kaiming_init_params",
 ]

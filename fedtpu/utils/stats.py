@@ -1,0 +1,38 @@
+"""Dataset statistics + init helpers (parity: ``src/utils.py:15-42`` —
+``get_mean_and_std`` and ``init_params``, which the reference defines but
+never calls; here they are tested and usable)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+def get_mean_and_std(images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel mean/std of an NHWC image array (the numbers hardcoded in
+    the reference's transform, ``src/main.py:39-47``, were computed this way)."""
+    images = np.asarray(images, np.float64)
+    mean = images.mean(axis=(0, 1, 2))
+    std = images.std(axis=(0, 1, 2))
+    return mean.astype(np.float32), std.astype(np.float32)
+
+
+def kaiming_init_params(params, rng: jax.Array):
+    """Re-initialise a param pytree: Kaiming-normal for rank>=2 weights
+    (fan_out, as the reference's ``init_params`` uses for convs), zeros for
+    biases/rank-1 leaves (parity: ``src/utils.py:29-42``)."""
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rngs = jax.random.split(rng, len(leaves))
+    out = []
+    for leaf, r in zip(leaves, rngs):
+        if leaf.ndim >= 2:
+            fan_out = leaf.shape[-1] * int(np.prod(leaf.shape[:-2]))
+            std = float(np.sqrt(2.0 / max(fan_out, 1)))
+            out.append(std * jax.random.normal(r, leaf.shape, leaf.dtype))
+        else:
+            out.append(jnp.zeros_like(leaf))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -34,4 +34,20 @@ __all__ = [
     "FedConfig",
     "OptimizerConfig",
     "RoundConfig",
+    "Federation",
+    "SoloTrainer",
 ]
+
+
+def __getattr__(name):
+    # Lazy: `fedtpu.Federation` / `fedtpu.SoloTrainer` without paying the
+    # jax/flax import chain for config-only users.
+    if name == "Federation":
+        from fedtpu.core import Federation
+
+        return Federation
+    if name == "SoloTrainer":
+        from fedtpu.core import SoloTrainer
+
+        return SoloTrainer
+    raise AttributeError(f"module 'fedtpu' has no attribute {name!r}")

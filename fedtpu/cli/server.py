@@ -72,12 +72,17 @@ def main(argv=None) -> int:
                     )
                     start_round = r + 1
                     logging.info("resumed global model from round %d", r)
-        for r in range(start_round, cfg.fed.num_rounds):
-            rec = primary.round()
-            logging.info("round %d: %s", r, rec)
+        def on_round(r: int, rec: dict) -> None:
             if ckpt is not None:
-                ckpt.save(r, {"params": primary.params,
-                              "batch_stats": primary.batch_stats})
+                ckpt.save(start_round + r,
+                          {"params": primary.params,
+                           "batch_stats": primary.batch_stats})
+
+        # run() (not a bare round() loop) so the heartbeat recovery thread
+        # and the backup liveness pinger actually run in the CLI deployment.
+        primary.run(
+            num_rounds=cfg.fed.num_rounds - start_round, on_round=on_round
+        )
         return 0
 
     backup = BackupServer(

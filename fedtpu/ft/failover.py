@@ -49,13 +49,19 @@ class FailoverStateMachine:
         on_promote: Optional[Callable[[], None]] = None,
         on_demote: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        arm_without_ping: bool = False,
     ):
         self.timeout = timeout
         self.on_promote = on_promote
         self.on_demote = on_demote
         self.clock = clock
         self.role = Role.BACKUP
-        self._last_ping = clock()
+        # The watchdog arms only once a primary has been heard at least once
+        # (deliberate divergence: the reference self-promotes ~10 s after
+        # boot even if no primary ever existed, src/server.py:254-264 —
+        # promoting with no replicated model serves clients a random init).
+        # ``arm_without_ping=True`` restores the reference behavior.
+        self._last_ping: Optional[float] = clock() if arm_without_ping else None
         self._lock = threading.Lock()
 
     def on_ping(self, recovering: bool) -> int:
@@ -83,6 +89,7 @@ class FailoverStateMachine:
         with self._lock:
             if (
                 self.role is Role.BACKUP
+                and self._last_ping is not None
                 and self.clock() - self._last_ping > self.timeout
             ):
                 self.role = Role.ACTING_PRIMARY
@@ -92,7 +99,10 @@ class FailoverStateMachine:
         return promote
 
     def seconds_since_ping(self) -> float:
+        """Seconds since the last primary ping; +inf if never pinged."""
         with self._lock:
+            if self._last_ping is None:
+                return float("inf")
             return self.clock() - self._last_ping
 
 

@@ -547,9 +547,12 @@ class PrimaryServer:
         self,
         num_rounds: Optional[int] = None,
         stop: Optional[Callable[[], bool]] = None,
+        on_round: Optional[Callable[[int, dict], None]] = None,
     ) -> List[dict]:
         """Drive rounds with background heartbeat + backup ping threads.
-        ``stop()`` is polled between rounds (used by failover demotion)."""
+        ``stop()`` is polled between rounds (used by failover demotion);
+        ``on_round(r, record)`` runs after each round (checkpointing,
+        metrics)."""
         if num_rounds is None:
             num_rounds = self.cfg.fed.num_rounds
         self.monitor.start()
@@ -568,6 +571,8 @@ class PrimaryServer:
                     break
                 rec = self.round()
                 log.info("round %d: %s", r, rec)
+                if on_round is not None:
+                    on_round(r, rec)
         finally:
             self.monitor.stop()
             if self.pinger is not None:

@@ -206,9 +206,11 @@ def test_backup_promotes_and_demotes(two_clients):
     backup_server = backup.start(backup_addr)
     stub = TrainerStub(create_channel(backup_addr))
     try:
-        # Seed replication state, as the primary would every round.
+        # Seed replication state, as the primary would every round, and arm
+        # the watchdog with one liveness ping (the pinger thread would).
         primary = PrimaryServer(cfg, addrs, backup_address=backup_addr)
         primary.round()
+        stub.CheckIfPrimaryUp(proto.PingRequest(req=b"0"), timeout=5)
         # Primary goes silent -> watchdog fires within ~2 ticks.
         deadline = time.time() + 15
         while backup.acting is None and time.time() < deadline:

@@ -85,6 +85,7 @@ def test_watchdog_promotes_after_timeout():
         clock=clock,
     )
     assert m.role is Role.BACKUP
+    m.on_ping(recovering=False)         # first ping arms the watchdog
     clock.advance(9.0)
     assert not m.check_watchdog()       # inside window
     m.on_ping(recovering=False)         # ping resets the window
@@ -99,6 +100,23 @@ def test_watchdog_promotes_after_timeout():
     assert not m.check_watchdog()
 
 
+def test_watchdog_unarmed_until_first_ping():
+    """No primary has ever pinged: never promote (the reference promotes a
+    model-less backup ~10 s after boot, src/server.py:254-264 — a bug we
+    deliberately fix; arm_without_ping=True restores it)."""
+    clock = FakeClock()
+    m = FailoverStateMachine(timeout=10.0, clock=clock)
+    clock.advance(1000.0)
+    assert not m.check_watchdog()
+    assert m.role is Role.BACKUP
+    assert m.seconds_since_ping() == float("inf")
+
+    legacy = FailoverStateMachine(timeout=10.0, clock=clock,
+                                  arm_without_ping=True)
+    clock.advance(11.0)
+    assert legacy.check_watchdog()      # reference-parity behavior
+
+
 def test_recovering_primary_demotes_acting_primary():
     clock = FakeClock()
     events = []
@@ -108,6 +126,7 @@ def test_recovering_primary_demotes_acting_primary():
         on_demote=lambda: events.append("demote"),
         clock=clock,
     )
+    m.on_ping(recovering=False)         # arm
     clock.advance(11.0)
     m.check_watchdog()
     assert m.role is Role.ACTING_PRIMARY
@@ -132,6 +151,7 @@ def test_full_failover_cycle():
     """backup -> acting primary -> demoted -> promoted again."""
     clock = FakeClock()
     m = FailoverStateMachine(timeout=10.0, clock=clock)
+    m.on_ping(recovering=False)
     clock.advance(11.0)
     assert m.check_watchdog()
     assert m.on_ping(recovering=True) == 1

@@ -25,6 +25,7 @@ import jax
 
 from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
 from fedtpu.core import Federation
+from fedtpu import data
 from fedtpu.data import load
 
 
@@ -56,7 +57,10 @@ def configs(quick: bool):
         return name, RoundConfig(
             model=model,
             num_classes=100 if dataset == "cifar100" else 10,
-            opt=OptimizerConfig(learning_rate=0.05),
+            # Constant LR: the reference never steps its cosine scheduler
+            # (src/main.py:231-242), so parity runs pin the effective
+            # constant-0.05 behavior.
+            opt=OptimizerConfig(learning_rate=0.05, schedule="constant"),
             data=DataConfig(
                 dataset=dataset,
                 batch_size=batch,
@@ -101,6 +105,7 @@ def run_one(name: str, cfg: RoundConfig) -> dict:
     test_loss, test_acc = fed.evaluate(*test)
     return {
         "config": name,
+        "data_source": data.data_source(cfg.data.dataset),
         "rounds_per_sec": round((cfg.fed.num_rounds - 1) / max(dt, 1e-9), 3),
         "train_acc": round(float(m.accuracy), 4),
         "test_acc": round(test_acc, 4),

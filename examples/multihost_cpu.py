@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Two-process multi-controller smoke: the REAL ``jax.distributed`` path.
+
+Run one copy of this per "host" (here: local processes standing in for TPU
+hosts; on a real slice each host runs the same program and the coordinator
+address comes from the environment):
+
+    python examples/multihost_cpu.py --process-id 0 --port 29500 &
+    python examples/multihost_cpu.py --process-id 1 --port 29500
+
+Each process brings up 4 virtual CPU devices, joins the 2-process cluster via
+``fedtpu.parallel.multihost.initialize`` (the exact call a pod deployment
+makes), builds one global 8-device ``clients`` mesh, and executes one full
+sharded federated round — cross-process FedAvg psum included. This is the
+CPU stand-in for the reference's multi-machine launch matrix
+(``README.md:6-17``), with collectives instead of gRPC.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Platform pinning must precede any jax backend initialisation: the
+# environment's TPU plugin ignores JAX_PLATFORMS (tests/conftest.py).
+from fedtpu.utils.platform import force_host_device_count  # noqa: E402
+
+force_host_device_count(4)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig  # noqa: E402
+from fedtpu import models  # noqa: E402
+from fedtpu.core import round as round_lib  # noqa: E402
+from fedtpu.parallel import (  # noqa: E402
+    client_mesh,
+    make_sharded_round_step,
+    multihost,
+    shard_batch,
+    shard_state,
+)
+
+NUM_PROCESSES = 2
+NUM_CLIENTS = 8
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--port", type=int, default=29500)
+    args = p.parse_args()
+
+    multihost.initialize(
+        f"localhost:{args.port}",
+        num_processes=NUM_PROCESSES,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == NUM_PROCESSES, jax.process_count()
+    n_dev = len(jax.devices())
+    assert n_dev == 4 * NUM_PROCESSES, n_dev
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(dataset="synthetic", batch_size=4),
+        fed=FedConfig(num_clients=NUM_CLIENTS),
+        steps_per_round=2,
+    )
+    mdl = models.create(cfg.model, num_classes=cfg.num_classes)
+    # Same seed on every host -> identical host-global state/data, of which
+    # each process materialises only its local devices' shards.
+    state = round_lib.init_state(
+        mdl, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32)
+    )
+    rng = np.random.default_rng(0)
+    n, s, b = NUM_CLIENTS, cfg.steps_per_round, cfg.data.batch_size
+    batch = round_lib.RoundBatch(
+        x=jnp.asarray(rng.normal(size=(n, s, b, 16, 16, 3)).astype(np.float32)),
+        y=jnp.asarray(rng.integers(0, 10, size=(n, s, b)).astype(np.int32)),
+        step_mask=jnp.ones((n, s), bool),
+        weights=jnp.ones((n,), jnp.float32),
+        alive=jnp.ones((n,), bool),
+    )
+
+    mesh = client_mesh(axis_name=cfg.mesh_axis)  # spans BOTH processes
+    local = multihost.local_client_slice(NUM_CLIENTS)
+    assert (local.stop - local.start) == NUM_CLIENTS // NUM_PROCESSES
+
+    step = make_sharded_round_step(mdl, cfg, mesh, donate=False)
+    new_state, metrics = step(
+        shard_state(state, mesh, cfg.mesh_axis),
+        shard_batch(batch, mesh, cfg.mesh_axis),
+    )
+    jax.block_until_ready(new_state)
+    assert int(metrics.num_active) == NUM_CLIENTS
+    print(
+        f"multihost ok: process {args.process_id}/{NUM_PROCESSES}, "
+        f"{n_dev} global devices, {NUM_CLIENTS} clients, "
+        f"loss={float(metrics.loss):.6f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

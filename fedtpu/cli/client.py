@@ -12,12 +12,13 @@ from __future__ import annotations
 import argparse
 import logging
 
-from fedtpu.cli.common import add_model_flags, build_config, compress_enabled
+from fedtpu.cli.common import add_model_flags, add_platform_flag, apply_platform_flag, build_config, compress_enabled
 from fedtpu.transport.federation import serve_client
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    add_platform_flag(p)
     add_model_flags(p)
     p.add_argument("-a", "--address", default="localhost:50051",
                    help="bind address (doubles as the client's identity)")
@@ -25,6 +26,7 @@ def main(argv=None) -> int:
                    help="total client count (for config only; actual world "
                    "arrives with each StartTrain)")
     args = p.parse_args(argv)
+    apply_platform_flag(args)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"

@@ -17,6 +17,40 @@ from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
 from fedtpu.data import dataset_info
 
 
+def add_platform_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu", "cuda"],
+        help="pin the jax platform. Setting JAX_PLATFORMS in the environment "
+        "is NOT always equivalent: a registered TPU plugin can ignore it "
+        "(and a wedged remote TPU backend then hangs the process); this flag "
+        "uses jax.config.update, which wins.",
+    )
+    p.add_argument(
+        "--fake-devices",
+        default=None,
+        type=int,
+        metavar="N",
+        help="with --platform cpu: present N virtual CPU devices "
+        "(the standard mesh-testing trick, SURVEY.md §4)",
+    )
+
+
+def apply_platform_flag(args) -> None:
+    """Apply --platform/--fake-devices. Must run before any jax device query;
+    safe because fedtpu modules import jax lazily enough that the backend is
+    uninitialised until the first model/data build."""
+    if getattr(args, "fake_devices", None):
+        from fedtpu.utils.platform import force_host_device_count
+
+        force_host_device_count(args.fake_devices)
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+
 def add_model_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--model",
@@ -29,6 +63,14 @@ def add_model_flags(p: argparse.ArgumentParser) -> None:
         choices=["cifar10", "cifar100", "mnist", "synthetic"],
     )
     p.add_argument("--lr", default=0.1, type=float, help="learning rate")
+    p.add_argument(
+        "--schedule",
+        default="constant",
+        choices=["constant", "cosine"],
+        help="LR schedule. 'constant' matches the reference's effective "
+        "behavior (its cosine scheduler is constructed but never stepped, "
+        "src/main.py:231-242); 'cosine' is the schedule it intended",
+    )
     p.add_argument("--batch-size", default=128, type=int)
     p.add_argument("--seed", default=0, type=int)
     p.add_argument(
@@ -76,7 +118,10 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
         model=args.model,
         num_classes=n_classes,
         image_size=shape,
-        opt=OptimizerConfig(learning_rate=args.lr),
+        opt=OptimizerConfig(
+            learning_rate=args.lr,
+            schedule=getattr(args, "schedule", "constant"),
+        ),
         data=DataConfig(
             dataset=args.dataset,
             batch_size=args.batch_size,

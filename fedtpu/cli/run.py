@@ -13,7 +13,7 @@ import logging
 import time
 
 from fedtpu.checkpoint import Checkpointer
-from fedtpu.cli.common import add_fed_flags, add_model_flags, build_config
+from fedtpu.cli.common import add_fed_flags, add_model_flags, add_platform_flag, apply_platform_flag, build_config
 from fedtpu.core import Federation
 from fedtpu.data import load
 from fedtpu.utils.metrics import MetricsLogger
@@ -21,6 +21,7 @@ from fedtpu.utils.metrics import MetricsLogger
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    add_platform_flag(p)
     add_model_flags(p)
     add_fed_flags(p)
     p.add_argument("--num-clients", default=2, type=int)
@@ -35,6 +36,7 @@ def main(argv=None) -> int:
     p.add_argument("--progress", action="store_true",
                    help="per-round progress bar (headless-safe)")
     args = p.parse_args(argv)
+    apply_platform_flag(args)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"

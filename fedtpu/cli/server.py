@@ -17,12 +17,13 @@ import jax
 import jax.numpy as jnp
 
 from fedtpu.checkpoint import Checkpointer
-from fedtpu.cli.common import add_fed_flags, add_model_flags, build_config, compress_enabled
+from fedtpu.cli.common import add_fed_flags, add_model_flags, add_platform_flag, apply_platform_flag, build_config, compress_enabled
 from fedtpu.transport.federation import BackupServer, PrimaryServer, _model_template
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    add_platform_flag(p)
     add_model_flags(p)
     add_fed_flags(p)
     p.add_argument("--p", default="N", help="y = run as primary")
@@ -40,6 +41,7 @@ def main(argv=None) -> int:
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
     args = p.parse_args(argv)
+    apply_platform_flag(args)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"

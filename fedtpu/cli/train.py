@@ -1,8 +1,11 @@
 """``python -m fedtpu.cli.train`` — standalone single-node training.
 
 Parity with the reference's original trainer surface (``src/main.py``:
-``--lr``, ``-r/--resume``, per-epoch test with best-accuracy checkpointing,
-cosine schedule) without its import-time side effects.
+``--lr``, ``-r/--resume``, per-epoch test with best-accuracy checkpointing)
+without its import-time side effects. LR schedule defaults to constant —
+the reference's effective behavior, since its cosine scheduler is never
+stepped (``src/main.py:231-242``); pass ``--schedule cosine`` for the
+schedule it intended.
 """
 
 from __future__ import annotations
@@ -10,21 +13,23 @@ from __future__ import annotations
 import argparse
 import logging
 
-from fedtpu.cli.common import add_model_flags, build_config
+from fedtpu.cli.common import add_model_flags, add_platform_flag, apply_platform_flag, build_config
 from fedtpu.core.solo import run_solo
 from fedtpu.utils.metrics import MetricsLogger
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    add_platform_flag(p)
     add_model_flags(p)
     p.add_argument("--epochs", default=200, type=int,
-                   help="training epochs (reference cosine T_max=200)")
+                   help="training epochs (reference default: 200)")
     p.add_argument("--checkpoint", default="./checkpoint/solo.fckpt",
                    help="best-accuracy checkpoint path")
     p.add_argument("-r", "--resume", action="store_true")
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
     args = p.parse_args(argv)
+    apply_platform_flag(args)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"

@@ -17,23 +17,35 @@ from typing import Optional, Tuple
 class OptimizerConfig:
     """Per-client local optimizer.
 
-    Defaults mirror the reference trainer: SGD(lr=0.1, momentum=0.9,
-    weight_decay=5e-4) with CosineAnnealingLR(T_max=200)
-    (reference: ``src/main.py:99-101``).
+    Defaults mirror the reference trainer's *effective* behavior:
+    SGD(lr=0.1, momentum=0.9, weight_decay=5e-4) at a CONSTANT learning rate.
+    The reference constructs CosineAnnealingLR(T_max=200)
+    (``src/main.py:101``) but never steps it — the driver loop containing
+    ``scheduler.step()`` is commented out (``src/main.py:231-242``) and the
+    federated ``train(epoch, rank, world)`` path (``src/main.py:128-165``)
+    doesn't step it either — so its effective LR is always 0.1.
+    ``schedule='cosine'`` implements the schedule the reference *intended*;
+    parity runs pin ``schedule='constant'``.
     """
 
     learning_rate: float = 0.1
     momentum: float = 0.9
     weight_decay: float = 5e-4
+    # constant (reference effective behavior) | cosine (reference intent).
+    schedule: str = "constant"
     # Cosine annealing horizon in *rounds* (the reference steps its scheduler
     # per epoch; in federated mode one round == one local epoch).
     cosine_t_max: int = 200
     nesterov: bool = False
 
     def lr_at(self, round_idx) -> float:
-        """Cosine-annealed learning rate for a given round (traceable)."""
+        """Learning rate for a given round (traceable)."""
         import jax.numpy as jnp
 
+        if self.schedule == "constant":
+            return jnp.asarray(self.learning_rate, jnp.float32)
+        if self.schedule != "cosine":
+            raise ValueError(f"unknown schedule: {self.schedule!r}")
         t = jnp.minimum(round_idx, self.cosine_t_max)
         return self.learning_rate * 0.5 * (
             1.0 + jnp.cos(jnp.pi * t / self.cosine_t_max)

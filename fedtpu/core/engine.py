@@ -3,8 +3,10 @@
 The user-facing replacement for the reference's ``run()`` orchestration
 (``src/server.py:113-153``): builds model + data + round step from a
 :class:`fedtpu.config.RoundConfig`, then drives rounds. Each round is one
-jitted call; data for the round is prepared on the host (static-shape batch
-tensors) and donated to the device.
+jitted call. The dataset and client-assignment matrix live in HBM
+(:mod:`fedtpu.data.device`): per-round batch gathering happens inside the
+jitted program, so the host contributes only the tiny ``alive`` mask per
+round — no per-round host data rebuild, no bulk H2D transfer.
 """
 
 from __future__ import annotations
@@ -29,7 +31,8 @@ from fedtpu.core.round import (
     make_round_step,
 )
 from fedtpu.core.client import make_eval_fn
-from fedtpu.data import dataset_info, load, partition
+from fedtpu.data import data_source, dataset_info, load, partition
+from fedtpu.data.device import make_data_round_step
 from fedtpu.utils.metrics import MetricsLogger
 
 
@@ -77,8 +80,12 @@ class Federation:
                 seed=cfg.data.seed,
                 num=cfg.data.num_examples,
             )
+            # Captured immediately after OUR load so an unrelated later load
+            # of the same dataset name can't relabel this run.
+            self._data_source = data_source(cfg.data.dataset, "train")
         else:
             images, labels = data
+            self._data_source = "caller"
         self.images, self.labels = images, labels
 
         n = cfg.fed.num_clients
@@ -102,12 +109,58 @@ class Federation:
         self._round_step = jax.jit(
             make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
         )
+        # Device-resident data (uploaded lazily on the first device-path
+        # step, so explicit-batch callers never pay the HBM footprint):
+        # dataset + assignment matrix go to HBM once; each round gathers its
+        # batches inside the jitted step.
+        self._device_data = None
+        self._data_key = jax.random.PRNGKey(cfg.data.seed)
+        self._data_step = jax.jit(
+            make_data_round_step(
+                self.model,
+                cfg,
+                self._steps,
+                compressor,
+                shuffle=cfg.data.partition != "round_robin",
+            ),
+            donate_argnums=(0,),
+        )
         self._evaluate = make_eval_fn(self.model.apply, cfg)
         self.alive = np.ones((n,), bool)
 
+    def _ensure_device_data(self):
+        if self._device_data is None:
+            self._device_data = (
+                jax.device_put(jnp.asarray(self.images, jnp.float32)),
+                jax.device_put(jnp.asarray(self.labels, jnp.int32)),
+                jax.device_put(jnp.asarray(self.client_idx)),
+                jax.device_put(jnp.asarray(self.client_mask)),
+            )
+        return self._device_data
+
     # ---------------------------------------------------------------- data
+    def _alive_for_round(self, round_idx: int) -> np.ndarray:
+        """This round's participation mask: heartbeat-dead clients plus
+        optional random subsampling of the live ones (the reference always
+        uses every live client)."""
+        alive = self.alive.copy()
+        frac = self.cfg.fed.participation_fraction
+        if frac < 1.0:
+            rng = np.random.default_rng(self.cfg.data.seed * 7919 + round_idx)
+            live = np.flatnonzero(alive)
+            k = max(1, int(round(frac * len(live))))
+            keep = rng.choice(live, size=k, replace=False)
+            alive = np.zeros_like(alive)
+            alive[keep] = True
+        return alive
+
     def round_batch(self, round_idx: int) -> RoundBatch:
-        """Materialise this round's static-shape batch tensors."""
+        """Materialise this round's batch tensors on the HOST.
+
+        Kept for tests and for callers that inject custom batches; the hot
+        path (:meth:`step` with ``batch=None``) gathers on device instead and
+        never calls this.
+        """
         cfg = self.cfg
         x, y, step_mask = partition.make_client_batches(
             self.images,
@@ -119,32 +172,52 @@ class Federation:
             seed=cfg.data.seed + round_idx,
             shuffle=cfg.data.partition != "round_robin",
         )
-        alive = self.alive.copy()
-        frac = cfg.fed.participation_fraction
-        if frac < 1.0:
-            # Client sampling: each round a random fraction of the *live*
-            # clients participates (standard FL subsampling; the reference
-            # always uses every live client).
-            rng = np.random.default_rng(cfg.data.seed * 7919 + round_idx)
-            live = np.flatnonzero(alive)
-            k = max(1, int(round(frac * len(live))))
-            keep = rng.choice(live, size=k, replace=False)
-            alive = np.zeros_like(alive)
-            alive[keep] = True
         return RoundBatch(
             x=jnp.asarray(x),
             y=jnp.asarray(y),
             step_mask=jnp.asarray(step_mask),
             weights=self.weights,
-            alive=jnp.asarray(alive),
+            alive=jnp.asarray(self._alive_for_round(round_idx)),
         )
 
     # --------------------------------------------------------------- rounds
+    @property
+    def state(self) -> FederatedState:
+        return self._state
+
+    @state.setter
+    def state(self, s: FederatedState) -> None:
+        # External assignment (e.g. checkpoint resume) invalidates the
+        # host-side round counter; it re-syncs from the device on next use.
+        self._state = s
+        self._round_host = None
+
+    def _round_number(self) -> int:
+        """Host-tracked current round. Avoids a blocking device readback of
+        ``state.round_idx`` every round (which would serialise dispatch
+        against the previous round's compute)."""
+        if self._round_host is None:
+            self._round_host = int(self._state.round_idx)
+        return self._round_host
+
     def step(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
-        r = int(self.state.round_idx)
-        if batch is None:
-            batch = self.round_batch(r)
-        self.state, metrics = self._round_step(self.state, batch)
+        r = self._round_number()
+        if batch is not None:
+            self._state, metrics = self._round_step(self._state, batch)
+            self._round_host = r + 1
+            return metrics
+        d_images, d_labels, d_idx, d_mask = self._ensure_device_data()
+        self._state, metrics = self._data_step(
+            self._state,
+            d_images,
+            d_labels,
+            d_idx,
+            d_mask,
+            self.weights,
+            jnp.asarray(self._alive_for_round(r)),
+            self._data_key,
+        )
+        self._round_host = r + 1
         return metrics
 
     def run(
@@ -166,6 +239,12 @@ class Federation:
                 "acc": metrics.accuracy,
                 "active": metrics.num_active,
                 "round_s": time.time() - t0,
+                "dataset": self.cfg.data.dataset,
+                # 'synthetic' when the loader fell back — accuracy curves from
+                # such runs must never be read as real-data results. Captured
+                # at construction from THIS instance's load (or 'caller' for
+                # injected data), immune to later unrelated loads.
+                "data_source": self._data_source,
             }
             if eval_every and (r + 1) % eval_every == 0 and eval_data is not None:
                 te_loss, te_acc = self.evaluate(*eval_data)

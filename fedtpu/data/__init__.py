@@ -1,5 +1,5 @@
 from fedtpu.data import partition
-from fedtpu.data.datasets import dataset_info, load
+from fedtpu.data.datasets import data_source, dataset_info, load
 from fedtpu.data.augment import augment_batch
 
-__all__ = ["partition", "load", "dataset_info", "augment_batch"]
+__all__ = ["partition", "load", "dataset_info", "data_source", "augment_batch"]

@@ -15,27 +15,56 @@ import gzip
 import os
 import pickle
 import struct
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
+
+# What the most recent load of each (dataset, split) actually used: "disk" or
+# "synthetic". Keyed per split because the loaders find per-split files — a
+# disk-backed test split must not relabel a synthetic-fallback train split.
+# Consumers (engine metrics, bench_parity) tag their output with this so a
+# synthetic-fallback run can never masquerade as a real-data result.
+_SOURCE: dict = {}
+_WARNED: set = set()
+
+
+def data_source(dataset: str, split: str = "train") -> str:
+    """'disk' | 'synthetic' | 'unknown' — source of the last
+    ``load(dataset, split)``."""
+    return _SOURCE.get((dataset, split), "unknown")
+
+
+def _record_source(dataset: str, source: str, split: str) -> None:
+    _SOURCE[(dataset, split)] = source
+    if source == "synthetic" and dataset != "synthetic" and dataset not in _WARNED:
+        _WARNED.add(dataset)
+        warnings.warn(
+            f"dataset '{dataset}' not found on disk (searched "
+            f"{list(_search_dirs())}); falling back to the "
+            "deterministic SYNTHETIC surrogate. Throughput numbers are valid; "
+            "accuracy numbers are NOT comparable to real-data runs.",
+            stacklevel=3,
+        )
 
 # Normalisation constants used by the reference transform (src/main.py:39-47).
 CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR10_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
 MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
 
-_SEARCH_DIRS = (
-    os.environ.get("FEDTPU_DATA_DIR", ""),
-    "./data",
-    os.path.expanduser("~/data"),
-    "/data",
-)
+def _search_dirs() -> Tuple[str, ...]:
+    # Evaluated per lookup (not at import) so FEDTPU_DATA_DIR set or changed
+    # after import — including test monkeypatching — takes effect. An
+    # explicitly-set FEDTPU_DATA_DIR is authoritative: the defaults are then
+    # NOT searched, so callers can guarantee which copy (or absence) is used.
+    explicit = os.environ.get("FEDTPU_DATA_DIR", "")
+    if explicit:
+        return (explicit,)
+    return ("./data", os.path.expanduser("~/data"), "/data")
 
 
 def _find(*names: str) -> Optional[str]:
-    for d in _SEARCH_DIRS:
-        if not d:
-            continue
+    for d in _search_dirs():
         for n in names:
             p = os.path.join(d, n)
             if os.path.exists(p):
@@ -67,7 +96,9 @@ def load_cifar10(split: str = "train", seed: int = 0):
     root = _find("cifar-10-batches-py")
     n = 50000 if split == "train" else 10000
     if root is None:
+        _record_source("cifar10", "synthetic", split)
         return _synthetic(n, (32, 32, 3), 10, seed, split)
+    _record_source("cifar10", "disk", split)
     files = (
         [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]
     )
@@ -86,7 +117,9 @@ def load_cifar100(split: str = "train", seed: int = 0):
     root = _find("cifar-100-python")
     n = 50000 if split == "train" else 10000
     if root is None:
+        _record_source("cifar100", "synthetic", split)
         return _synthetic(n, (32, 32, 3), 100, seed + 10, split)
+    _record_source("cifar100", "disk", split)
     with open(os.path.join(root, split if split != "train" else "train"), "rb") as fh:
         d = pickle.load(fh, encoding="bytes")
     x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
@@ -112,8 +145,10 @@ def load_mnist(split: str = "train", seed: int = 0):
                 f"MNIST/raw/{prefix}-labels-idx1-ubyte")
     n = 60000 if split == "train" else 10000
     if img is None or lbl is None:
+        _record_source("mnist", "synthetic", split)
         x, y = _synthetic(n, (28, 28, 1), 10, seed + 20, split)
         return x, y
+    _record_source("mnist", "disk", split)
     x = _read_idx(img).astype(np.float32)[..., None]
     x = (x / 255.0 - MNIST_MEAN) / MNIST_STD
     return x, _read_idx(lbl).astype(np.int32)
@@ -133,6 +168,7 @@ def load(dataset: str, split: str = "train", seed: int = 0, num: Optional[int] =
         raise KeyError(f"unknown dataset '{dataset}'; have {sorted(_LOADERS)}")
     loader, shape, classes = _LOADERS[dataset]
     if loader is None:
+        _record_source(dataset, "synthetic", split)
         x, y = _synthetic(num or 8192, shape, classes, seed, split)
     else:
         x, y = loader(split, seed)

@@ -77,13 +77,32 @@ def make_sharded_round_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def _put(x, mesh: Mesh, spec) -> jax.Array:
+    """Place a host-global array onto the mesh.
+
+    Single-process: plain ``device_put`` (device-to-device for inputs already
+    on device — no host roundtrip). Multi-controller: ``make_array_from_callback``
+    so each process materialises only the shards its local devices own, even
+    though the mesh spans every host (see :mod:`fedtpu.parallel.multihost`).
+    """
+    import numpy as np
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def shard_state(state: FederatedState, mesh: Mesh, axis: str) -> FederatedState:
     """Place a host-built FederatedState onto the mesh with the right
     shardings (global model replicated, client state split)."""
     specs = state_specs(axis)
 
     def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _put(x, mesh, spec)
 
     return FederatedState(
         params=jax.tree.map(lambda x: put(x, specs.params), state.params),
@@ -99,7 +118,7 @@ def shard_state(state: FederatedState, mesh: Mesh, axis: str) -> FederatedState:
 
 def shard_batch(batch: RoundBatch, mesh: Mesh, axis: str) -> RoundBatch:
     def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _put(x, mesh, spec)
 
     return RoundBatch(
         x=put(batch.x, P(axis)),

@@ -1,0 +1,30 @@
+"""Platform/env plumbing shared by every process entry point.
+
+One canonical implementation of the XLA virtual-device-count flag munging so
+the CLI, the driver entry and the examples cannot drift (each previously
+hand-rolled its own append/replace of ``--xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import MutableMapping, Optional
+
+
+def force_host_device_count(
+    n: int, env: Optional[MutableMapping[str, str]] = None
+) -> None:
+    """Set ``--xla_force_host_platform_device_count=n`` in ``env`` (default:
+    ``os.environ``), replacing any existing occurrence. Must run before jax
+    initialises its backends to have any effect."""
+    if env is None:
+        env = os.environ
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n)}"
+    ).strip()

@@ -1,0 +1,49 @@
+"""LR-schedule parity semantics.
+
+The reference constructs ``CosineAnnealingLR(T_max=200)`` (``src/main.py:101``)
+but never steps it: the driver loop containing ``scheduler.step()`` is
+commented out (``src/main.py:231-242``) and the federated
+``train(epoch, rank, world)`` path (``src/main.py:128-165``) doesn't step it
+either, so the reference's effective learning rate is a constant 0.1. fedtpu
+therefore defaults ``OptimizerConfig.schedule`` to ``'constant'`` for parity
+and offers ``'cosine'`` as the schedule the reference *intended*. These tests
+pin that divergence so it can never silently flip.
+"""
+
+import numpy as np
+import pytest
+
+from fedtpu.config import OptimizerConfig
+
+
+def test_default_schedule_is_constant_reference_parity():
+    opt = OptimizerConfig()
+    assert opt.schedule == "constant"
+    for r in (0, 1, 100, 200, 1000):
+        assert float(opt.lr_at(r)) == pytest.approx(opt.learning_rate)
+
+
+def test_cosine_schedule_anneals():
+    opt = OptimizerConfig(learning_rate=0.1, schedule="cosine", cosine_t_max=200)
+    assert float(opt.lr_at(0)) == pytest.approx(0.1)
+    assert float(opt.lr_at(100)) == pytest.approx(0.05, abs=1e-6)
+    assert float(opt.lr_at(200)) == pytest.approx(0.0, abs=1e-6)
+    # Clamped past the horizon, like torch CosineAnnealingLR's floor.
+    assert float(opt.lr_at(500)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_cosine_diverges_from_reference_effective_lr():
+    constant = OptimizerConfig(schedule="constant")
+    cosine = OptimizerConfig(schedule="cosine")
+    # Identical at round 0, diverging after — the reason parity configs must
+    # pin schedule='constant'.
+    assert float(cosine.lr_at(0)) == pytest.approx(float(constant.lr_at(0)))
+    diffs = [
+        abs(float(cosine.lr_at(r)) - float(constant.lr_at(r))) for r in (10, 50, 150)
+    ]
+    assert np.all(np.asarray(diffs) > 1e-4)
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        OptimizerConfig(schedule="linear").lr_at(0)

@@ -181,6 +181,21 @@ def _measure():
     return result
 
 
+def _salvage_json(text: str):
+    """Last line of ``text`` that parses as a JSON object, or None. Guards
+    against truncated lines from a killed child being shipped as the
+    artifact."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            return line
+    return None
+
+
 def main():
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))
@@ -201,22 +216,19 @@ def main():
             # The child may have printed its measurement BEFORE wedging in
             # backend/interpreter teardown — salvage it from captured output.
             out = exc.stdout or b""
-            out = out.decode() if isinstance(out, bytes) else out
-            for line in reversed(out.strip().splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    print(line)
-                    return
+            line = _salvage_json(out.decode() if isinstance(out, bytes) else out)
+            if line:
+                print(line)
+                return
             last_err = f"attempt {attempt + 1}: timeout after {ATTEMPT_TIMEOUT_S}s"
             continue
         # Accept a printed measurement even on nonzero exit: a backend that
         # segfaults during interpreter teardown (after the JSON was emitted)
         # must not cost two more 20-minute attempts.
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                print(line)
-                return
+        line = _salvage_json(proc.stdout)
+        if line:
+            print(line)
+            return
         last_err = (
             f"attempt {attempt + 1}: rc={proc.returncode}, no JSON: "
             + proc.stderr.strip()[-1500:]

@@ -10,26 +10,64 @@ of (compressed, new_residual) — so the compression path stays
 bandwidth-minimal regardless of what the surrounding program does to XLA's
 fusion decisions.
 
+Tiling obeys Mosaic's (8, 128) f32 tile rule: blocks are 8 client rows by a
+lane-aligned column slice (~1 MB per operand per grid step — small enough
+that the 4 double-buffered operands of the threshold kernel stay inside the
+16 MB VMEM scoped limit, verified by deviceless AOT compilation for a v5e
+target via ``tools/compile_pallas_tpu.py``). Per-row scalars (thresholds /
+scales) ride as a ``[rows, 1]`` column so their block shape satisfies the
+same rule.
+
 Kernels run in interpret mode off-TPU so the same code path is exercised by
-the CPU test suite (see ``tests/conftest.py``).
+the CPU test suite (see ``tests/conftest.py``); pass ``interpret=False`` to
+force Mosaic lowering (used by the AOT compile check).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# Column-block size in elements: 256K f32 = 1 MB per operand per grid step —
-# large enough that grid dispatch is negligible, small enough that the 4-5
-# operands of a step stay well inside the ~16 MB of VMEM.
-_BLOCK = 256 * 1024
+# Max column-block size in elements: 32K f32 x 8 rows = 1 MB per operand per
+# grid step — large enough that grid dispatch is negligible, small enough
+# that the operands of a step (double-buffered) stay well inside VMEM.
+_BLOCK_COLS = 32 * 1024
+_BLOCK_ROWS = 8
+assert _BLOCK_COLS % 128 == 0, "column blocks must stay lane-aligned"
 
 
-def _interpret() -> bool:
+# Process-wide default for the interpret decision, settable because "what
+# platform will this trace target?" is not knowable from inside a kernel
+# wrapper during deviceless AOT lowering (default_backend() is cpu even when
+# compiling FOR a TPU topology). Set BEFORE the first traced call — the
+# wrappers are jitted and cache their trace.
+_INTERPRET_DEFAULT: Optional[bool] = None
+
+
+def set_interpret_default(value: Optional[bool]) -> None:
+    global _INTERPRET_DEFAULT
+    _INTERPRET_DEFAULT = value
+
+
+def _interpret(override: Optional[bool]) -> bool:
+    if override is not None:
+        return override
+    if _INTERPRET_DEFAULT is not None:
+        return _INTERPRET_DEFAULT
     return jax.default_backend() != "tpu"
+
+
+def _blocks(rows: int, cols: int):
+    """Mosaic-legal (row_block, col_block): rows tiled by 8 (or the full dim
+    when smaller), columns tiled by the (lane-aligned) ``_BLOCK_COLS`` unless
+    the block spans the whole dimension."""
+    rb = rows if rows <= _BLOCK_ROWS else _BLOCK_ROWS
+    cb = cols if cols <= _BLOCK_COLS else _BLOCK_COLS
+    return rb, cb
 
 
 def _threshold_kernel(y_ref, t_ref, out_ref, new_e_ref):
@@ -40,14 +78,16 @@ def _threshold_kernel(y_ref, t_ref, out_ref, new_e_ref):
     top-k threshold), so the kernel reads ONE full-size operand.
     """
     y = y_ref[...]
-    keep = jnp.abs(y) >= t_ref[0]
+    keep = jnp.abs(y) >= t_ref[...]  # [rows, 1] broadcasts over [rows, cols]
     out = jnp.where(keep, y, jnp.zeros_like(y))
     out_ref[...] = out
     new_e_ref[...] = y - out
 
 
-@functools.partial(jax.jit, static_argnames=())
-def threshold_with_feedback(y: jnp.ndarray, thresh: jnp.ndarray):
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def threshold_with_feedback(
+    y: jnp.ndarray, thresh: jnp.ndarray, interpret: Optional[bool] = None
+):
     """Fused ``out = y * (|y| >= thresh); new_e = y - out``.
 
     ``y: [rows, cols]`` (rows = clients, cols = leaf size; the caller's
@@ -55,39 +95,40 @@ def threshold_with_feedback(y: jnp.ndarray, thresh: jnp.ndarray):
     Returns ``(out, new_e)``.
     """
     rows, cols = y.shape
-    col_block = min(cols, _BLOCK)
-    # Grid: one client row per step, columns tiled in ~1 MB blocks.
-    grid = (rows, pl.cdiv(cols, col_block))
+    rb, cb = _blocks(rows, cols)
+    grid = (pl.cdiv(rows, rb), pl.cdiv(cols, cb))
     return pl.pallas_call(
         _threshold_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
-            pl.BlockSpec((1,), lambda r, c: (r,)),
+            pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
+            pl.BlockSpec((rb, 1), lambda r, c: (r, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
-            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+            pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
+            pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(y.shape, y.dtype),
             jax.ShapeDtypeStruct(y.shape, y.dtype),
         ],
-        interpret=_interpret(),
-    )(y, thresh)
+        interpret=_interpret(interpret),
+    )(y, thresh.reshape(rows, 1))
 
 
 def _quantdequant_kernel(x_ref, s_ref, out_ref):
     """One tile of simulated int8 quantize-dequantize: round(x/s) * s."""
-    s = s_ref[0]
+    s = s_ref[...]  # [rows, 1]
     # Guard the all-zero leaf: scale 0 would produce NaN via 0/0.
     safe = jnp.where(s > 0, s, jnp.ones_like(s))
     q = jnp.clip(jnp.round(x_ref[...] / safe), -127.0, 127.0)
     out_ref[...] = q * safe
 
 
-@functools.partial(jax.jit, static_argnames=())
-def quantdequant_int8(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantdequant_int8(
+    x: jnp.ndarray, scale: jnp.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
     """Simulated symmetric int8 codec: ``clip(round(x/scale), ±127) * scale``.
 
     ``x: [rows, cols]``, ``scale: [rows]`` (per-client max|x|/127). The wire
@@ -96,16 +137,16 @@ def quantdequant_int8(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     quantize-dequantize so aggregation sees exactly the wire numbers.
     """
     rows, cols = x.shape
-    col_block = min(cols, _BLOCK)
-    grid = (rows, pl.cdiv(cols, col_block))
+    rb, cb = _blocks(rows, cols)
+    grid = (pl.cdiv(rows, rb), pl.cdiv(cols, cb))
     return pl.pallas_call(
         _quantdequant_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
-            pl.BlockSpec((1,), lambda r, c: (r,)),
+            pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
+            pl.BlockSpec((rb, 1), lambda r, c: (r, 0)),
         ],
-        out_specs=pl.BlockSpec((1, col_block), lambda r, c: (r, c)),
+        out_specs=pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        interpret=_interpret(),
-    )(x, scale)
+        interpret=_interpret(interpret),
+    )(x, scale.reshape(rows, 1))

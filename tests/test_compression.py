@@ -202,3 +202,18 @@ def test_compressed_training_still_converges():
         state, m = step(state, batch)
         losses.append(float(m.loss))
     assert losses[-1] < losses[0]
+
+
+def test_pallas_blocks_are_mosaic_legal():
+    """Block shapes must satisfy Mosaic's tiling rule: last two block dims
+    divisible by (8, 128) or equal to the whole array dim (the constraint
+    that rejected the original (1, N) row-tiling — see
+    tools/compile_pallas_tpu.py for the deviceless TPU compile proof)."""
+    from fedtpu.ops.pallas_kernels import _blocks
+
+    for rows, cols in [(1, 7), (2, 100), (8, 128), (64, 3_217_226),
+                       (12, 50_000), (64, 32 * 1024), (3, 129)]:
+        rb, cb = _blocks(rows, cols)
+        assert rb == rows or rb % 8 == 0, (rows, cols, rb)
+        assert cb == cols or cb % 128 == 0, (rows, cols, cb)
+        assert rb <= rows and cb <= cols

@@ -26,6 +26,13 @@ def main(argv=None) -> int:
     add_fed_flags(p)
     p.add_argument("--num-clients", default=2, type=int)
     p.add_argument("--steps-per-round", default=8, type=int)
+    p.add_argument(
+        "--mesh",
+        default="auto",
+        choices=["auto", "off"],
+        help="auto: when >1 device is visible and num-clients divides evenly, "
+        "shard the clients axis over all devices (shard_map + psum FedAvg)",
+    )
     p.add_argument("--eval-every", default=5, type=int)
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
     p.add_argument("--checkpoint-dir", default=None)
@@ -44,7 +51,17 @@ def main(argv=None) -> int:
     cfg = build_config(
         args, num_clients=args.num_clients, steps_per_round=args.steps_per_round
     )
-    fed = Federation(cfg, seed=args.seed)
+    mesh = None
+    if args.mesh == "auto":
+        import jax
+
+        n_dev = len(jax.devices())
+        if n_dev > 1 and args.num_clients % n_dev == 0:
+            from fedtpu.parallel import client_mesh
+
+            mesh = client_mesh()
+            logging.info("clients axis sharded over %d devices", n_dev)
+    fed = Federation(cfg, seed=args.seed, mesh=mesh)
 
     ckpt = None
     start_round = 0
@@ -77,6 +94,10 @@ def main(argv=None) -> int:
                 "loss": float(metrics.loss),
                 "acc": float(metrics.accuracy),
                 "active": float(metrics.num_active),
+                "dataset": cfg.data.dataset,
+                # 'synthetic' marks loader-fallback runs: their accuracy
+                # curves are not comparable to real-data results.
+                "data_source": fed.data_source,
             }
             if args.eval_every and (r + 1) % args.eval_every == 0:
                 rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)

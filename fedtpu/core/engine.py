@@ -54,8 +54,15 @@ class Federation:
         seed: int = 0,
         compressor: Optional["Compressor"] = None,
         data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        mesh=None,
     ):
+        """``mesh``: an optional ``jax.sharding.Mesh`` over a ``clients``
+        axis — rounds then run under ``shard_map`` with per-client state and
+        data sharded across its devices and FedAvg as a psum over ICI
+        (:mod:`fedtpu.parallel`). ``None`` keeps the single-program path
+        (one chip, or tests)."""
         self.cfg = cfg
+        self.mesh = mesh
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -106,35 +113,60 @@ class Federation:
         self.state: FederatedState = init_state(
             self.model, cfg, jax.random.PRNGKey(seed), sample, compressor
         )
-        self._round_step = jax.jit(
-            make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
-        )
+        shuffle = cfg.data.partition != "round_robin"
+        if mesh is None:
+            self._round_step = jax.jit(
+                make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
+            )
+            self._data_step = jax.jit(
+                make_data_round_step(
+                    self.model, cfg, self._steps, compressor, shuffle=shuffle
+                ),
+                donate_argnums=(0,),
+            )
+        else:
+            from fedtpu.data.device import make_sharded_data_round_step
+            from fedtpu.parallel.sharded import (
+                make_sharded_round_step,
+                shard_state,
+            )
+
+            self._round_step = make_sharded_round_step(
+                self.model, cfg, mesh, compressor
+            )
+            self._data_step = make_sharded_data_round_step(
+                self.model, cfg, self._steps, mesh, compressor, shuffle=shuffle
+            )
+            self.state = shard_state(self.state, mesh, cfg.mesh_axis)
+            self.weights = self._placed(self.weights, sharded=True)
         # Device-resident data (uploaded lazily on the first device-path
         # step, so explicit-batch callers never pay the HBM footprint):
         # dataset + assignment matrix go to HBM once; each round gathers its
         # batches inside the jitted step.
         self._device_data = None
         self._data_key = jax.random.PRNGKey(cfg.data.seed)
-        self._data_step = jax.jit(
-            make_data_round_step(
-                self.model,
-                cfg,
-                self._steps,
-                compressor,
-                shuffle=cfg.data.partition != "round_robin",
-            ),
-            donate_argnums=(0,),
-        )
         self._evaluate = make_eval_fn(self.model.apply, cfg)
         self.alive = np.ones((n,), bool)
 
+    def _placed(self, x, sharded: bool):
+        """Place an array for the active topology: sharded along the clients
+        axis (or replicated) on the mesh, or a plain device_put without one."""
+        if self.mesh is None:
+            return jax.device_put(jnp.asarray(x))
+        from fedtpu.parallel.sharded import _put
+        from jax.sharding import PartitionSpec as P
+
+        return _put(x, self.mesh, P(self.cfg.mesh_axis) if sharded else P())
+
     def _ensure_device_data(self):
         if self._device_data is None:
+            # Dataset replicated (every device gathers its own clients'
+            # batches locally); assignment matrix sharded by client.
             self._device_data = (
-                jax.device_put(jnp.asarray(self.images, jnp.float32)),
-                jax.device_put(jnp.asarray(self.labels, jnp.int32)),
-                jax.device_put(jnp.asarray(self.client_idx)),
-                jax.device_put(jnp.asarray(self.client_mask)),
+                self._placed(np.asarray(self.images, np.float32), sharded=False),
+                self._placed(np.asarray(self.labels, np.int32), sharded=False),
+                self._placed(self.client_idx, sharded=True),
+                self._placed(self.client_mask, sharded=True),
             )
         return self._device_data
 
@@ -180,6 +212,12 @@ class Federation:
             alive=jnp.asarray(self._alive_for_round(round_idx)),
         )
 
+    @property
+    def data_source(self) -> str:
+        """'disk' | 'synthetic' | 'caller' — where this instance's training
+        data came from (captured at construction)."""
+        return self._data_source
+
     # --------------------------------------------------------------- rounds
     @property
     def state(self) -> FederatedState:
@@ -203,6 +241,10 @@ class Federation:
     def step(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
         r = self._round_number()
         if batch is not None:
+            if self.mesh is not None:
+                from fedtpu.parallel.sharded import shard_batch
+
+                batch = shard_batch(batch, self.mesh, self.cfg.mesh_axis)
             self._state, metrics = self._round_step(self._state, batch)
             self._round_host = r + 1
             return metrics
@@ -214,7 +256,7 @@ class Federation:
             d_idx,
             d_mask,
             self.weights,
-            jnp.asarray(self._alive_for_round(r)),
+            self._placed(self._alive_for_round(r), sharded=True),
             self._data_key,
         )
         self._round_host = r + 1

@@ -65,14 +65,21 @@ def make_data_round_step(
     steps: int,
     compressor=None,
     shuffle: bool = True,
+    axis_name: Optional[str] = None,
 ) -> Callable[..., Tuple[FederatedState, RoundMetrics]]:
     """Round step that gathers its own batches from the device-resident
     dataset: ``step(state, images, labels, idx, mask, weights, alive,
     data_key)``. The gather + reshape fuse into the same XLA program as the
     local training scan and the FedAvg aggregation, so the host contributes
     nothing per round beyond the (tiny) ``alive`` mask.
+
+    With ``axis_name`` set this is the per-shard body for ``shard_map`` over
+    a clients mesh (see :func:`make_sharded_data_round_step`): ``idx``,
+    ``mask``, ``weights`` and ``alive`` are then the LOCAL client rows while
+    ``images``/``labels`` are replicated, so each device gathers only its own
+    clients' batches and aggregation psums over the mesh.
     """
-    base = make_round_step(model, cfg, compressor)
+    base = make_round_step(model, cfg, compressor, axis_name=axis_name)
     batch_size = cfg.data.batch_size
     need = steps * batch_size
 
@@ -87,7 +94,14 @@ def make_data_round_step(
         data_key: jax.Array,
     ) -> Tuple[FederatedState, RoundMetrics]:
         n = idx.shape[0]
-        rng = jax.random.fold_in(data_key, state.round_idx) if shuffle else None
+        rng = None
+        if shuffle:
+            rng = jax.random.fold_in(data_key, state.round_idx)
+            if axis_name is not None:
+                # Decorrelate shuffles across mesh shards (the body sees only
+                # its local client rows; without this every device would draw
+                # the same per-row permutation pattern).
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
         take = round_take_indices(idx, mask, need, rng)
         x = images[take].reshape((n, steps, batch_size) + images.shape[1:])
         y = labels[take].reshape((n, steps, batch_size))
@@ -99,3 +113,54 @@ def make_data_round_step(
         return base(state, batch)
 
     return step
+
+
+def make_sharded_data_round_step(
+    model,
+    cfg: RoundConfig,
+    steps: int,
+    mesh,
+    compressor=None,
+    shuffle: bool = True,
+    donate: bool = True,
+):
+    """Mesh-parallel round step with the on-device gather inside each shard.
+
+    The clients axis of per-client state/assignment is sharded over ``mesh``;
+    the dataset is replicated to every device (CIFAR-scale data fits HBM many
+    times over, and replication keeps the gather local — no cross-chip
+    data motion); FedAvg psums over ICI. Call signature matches
+    :func:`make_data_round_step`; inputs must be placed with
+    :func:`shard_data_arrays` / :func:`fedtpu.parallel.shard_state`.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fedtpu.parallel.sharded import state_specs
+
+    axis = cfg.mesh_axis
+    if cfg.fed.num_clients % mesh.devices.size:
+        raise ValueError(
+            f"num_clients={cfg.fed.num_clients} not divisible by mesh size "
+            f"{mesh.devices.size}"
+        )
+    body = make_data_round_step(
+        model, cfg, steps, compressor, shuffle=shuffle, axis_name=axis
+    )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            state_specs(axis),  # state
+            P(),                # images (replicated)
+            P(),                # labels (replicated)
+            P(axis),            # idx
+            P(axis),            # mask
+            P(axis),            # weights
+            P(axis),            # alive
+            P(),                # data_key
+        ),
+        out_specs=(state_specs(axis), RoundMetrics(P(), P(), P(), P())),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

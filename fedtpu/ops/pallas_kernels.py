@@ -18,9 +18,13 @@ target via ``tools/compile_pallas_tpu.py``). Per-row scalars (thresholds /
 scales) ride as a ``[rows, 1]`` column so their block shape satisfies the
 same rule.
 
-Kernels run in interpret mode off-TPU so the same code path is exercised by
-the CPU test suite (see ``tests/conftest.py``); pass ``interpret=False`` to
-force Mosaic lowering (used by the AOT compile check).
+Mode selection: on TPU the kernels lower through Mosaic. Off-TPU the DEFAULT
+is a plain-jnp equivalent (XLA fuses the same chain; Pallas interpret mode
+costs ~1000x on CPU and is pure overhead in production paths like the
+cpu-scale parity bench). Pass ``interpret=True`` to force the interpreted
+``pallas_call`` — the CPU test suite does this to exercise the actual kernel
+bodies — or ``interpret=False`` to force Mosaic (the deviceless AOT compile
+check, ``tools/compile_pallas_tpu.py``).
 """
 
 from __future__ import annotations
@@ -40,7 +44,7 @@ _BLOCK_ROWS = 8
 assert _BLOCK_COLS % 128 == 0, "column blocks must stay lane-aligned"
 
 
-# Process-wide default for the interpret decision, settable because "what
+# Process-wide default for the mode decision, settable because "what
 # platform will this trace target?" is not knowable from inside a kernel
 # wrapper during deviceless AOT lowering (default_backend() is cpu even when
 # compiling FOR a TPU topology). Set BEFORE the first traced call — the
@@ -53,12 +57,18 @@ def set_interpret_default(value: Optional[bool]) -> None:
     _INTERPRET_DEFAULT = value
 
 
-def _interpret(override: Optional[bool]) -> bool:
-    if override is not None:
-        return override
-    if _INTERPRET_DEFAULT is not None:
-        return _INTERPRET_DEFAULT
-    return jax.default_backend() != "tpu"
+def _mode(override: Optional[bool]) -> str:
+    """'mosaic' (pallas, compiled) | 'interpret' (pallas, interpreted) |
+    'xla' (plain-jnp equivalent, off-TPU default)."""
+    if override is True:
+        return "interpret"
+    if override is False:
+        return "mosaic"
+    if _INTERPRET_DEFAULT is True:
+        return "interpret"
+    if _INTERPRET_DEFAULT is False:
+        return "mosaic"
+    return "mosaic" if jax.default_backend() == "tpu" else "xla"
 
 
 def _blocks(rows: int, cols: int):
@@ -95,6 +105,10 @@ def threshold_with_feedback(
     Returns ``(out, new_e)``.
     """
     rows, cols = y.shape
+    mode = _mode(interpret)
+    if mode == "xla":
+        out = jnp.where(jnp.abs(y) >= thresh[:, None], y, jnp.zeros_like(y))
+        return out, y - out
     rb, cb = _blocks(rows, cols)
     grid = (pl.cdiv(rows, rb), pl.cdiv(cols, cb))
     return pl.pallas_call(
@@ -112,7 +126,7 @@ def threshold_with_feedback(
             jax.ShapeDtypeStruct(y.shape, y.dtype),
             jax.ShapeDtypeStruct(y.shape, y.dtype),
         ],
-        interpret=_interpret(interpret),
+        interpret=mode == "interpret",
     )(y, thresh.reshape(rows, 1))
 
 
@@ -137,6 +151,11 @@ def quantdequant_int8(
     quantize-dequantize so aggregation sees exactly the wire numbers.
     """
     rows, cols = x.shape
+    mode = _mode(interpret)
+    if mode == "xla":
+        s = scale[:, None]
+        safe = jnp.where(s > 0, s, jnp.ones_like(s))
+        return jnp.clip(jnp.round(x / safe), -127.0, 127.0) * safe
     rb, cb = _blocks(rows, cols)
     grid = (pl.cdiv(rows, rb), pl.cdiv(cols, cb))
     return pl.pallas_call(
@@ -148,5 +167,5 @@ def quantdequant_int8(
         ],
         out_specs=pl.BlockSpec((rb, cb), lambda r, c: (r, c)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        interpret=_interpret(interpret),
+        interpret=mode == "interpret",
     )(x, scale.reshape(rows, 1))

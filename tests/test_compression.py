@@ -30,27 +30,32 @@ def tree_of_deltas(rng, n=4):
 def test_threshold_kernel_matches_oracle(rng):
     y = jnp.asarray(rng.normal(size=(3, 1000)).astype(np.float32))
     t = jnp.asarray([0.5, 1.0, 2.0], jnp.float32)
-    out, new_e = pk.threshold_with_feedback(y, t)
-    yn = np.asarray(y)
-    keep = np.abs(yn) >= np.asarray(t)[:, None]
-    np.testing.assert_allclose(np.asarray(out), yn * keep, atol=1e-6)
-    np.testing.assert_allclose(np.asarray(new_e), yn * ~keep, atol=1e-6)
+    # interpret=True forces the actual pallas_call body (the off-TPU default
+    # is the plain-jnp equivalent); both paths are checked against the oracle.
+    for kw in ({"interpret": True}, {}):
+        out, new_e = pk.threshold_with_feedback(y, t, **kw)
+        yn = np.asarray(y)
+        keep = np.abs(yn) >= np.asarray(t)[:, None]
+        np.testing.assert_allclose(np.asarray(out), yn * keep, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(new_e), yn * ~keep, atol=1e-6)
 
 
 def test_quantdequant_kernel_matches_oracle(rng):
     x = jnp.asarray(rng.normal(size=(2, 513)).astype(np.float32))
     scale = jnp.max(jnp.abs(x), axis=1) / 127.0
-    out = pk.quantdequant_int8(x, scale)
-    s = np.asarray(scale)[:, None]
-    expected = np.clip(np.round(np.asarray(x) / s), -127, 127) * s
-    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-6)
+    for kw in ({"interpret": True}, {}):
+        out = pk.quantdequant_int8(x, scale, **kw)
+        s = np.asarray(scale)[:, None]
+        expected = np.clip(np.round(np.asarray(x) / s), -127, 127) * s
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-6)
 
 
 def test_quantdequant_zero_leaf_is_safe():
     x = jnp.zeros((2, 64), jnp.float32)
-    out = pk.quantdequant_int8(x, jnp.zeros((2,), jnp.float32))
-    assert np.all(np.isfinite(np.asarray(out)))
-    np.testing.assert_allclose(np.asarray(out), 0.0)
+    for kw in ({"interpret": True}, {}):
+        out = pk.quantdequant_int8(x, jnp.zeros((2,), jnp.float32), **kw)
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(np.asarray(out), 0.0)
 
 
 # -------------------------------------------------------------------- codecs

@@ -112,3 +112,40 @@ def test_synthetic_fallback_is_loud_and_tagged(tmp_path, monkeypatch):
     # The explicit synthetic dataset is tagged but never warns.
     datasets.load("synthetic", "train", num=64)
     assert datasets.data_source("synthetic") == "synthetic"
+
+
+def test_engine_mesh_path_matches_single_program(eight_devices):
+    """Federation(mesh=...) — shard_map + psum + on-device sharded gather —
+    must produce the same round as the single-program path (round_robin is
+    unshuffled, so data order matches bit-for-bit)."""
+    from fedtpu.parallel import client_mesh
+
+    cfg = _cfg(
+        fed=FedConfig(num_clients=8),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=128,
+        ),
+    )
+    single = Federation(cfg, seed=0)
+    meshed = Federation(cfg, seed=0, mesh=client_mesh(8))
+
+    m1 = single.step()
+    m2 = meshed.step()
+    assert int(m2.num_active) == 8
+    np.testing.assert_allclose(float(m1.loss), float(m2.loss), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(single.state.params),
+        jax.tree_util.tree_leaves(meshed.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_engine_mesh_path_dead_client(eight_devices):
+    from fedtpu.parallel import client_mesh
+
+    cfg = _cfg(fed=FedConfig(num_clients=8))
+    fed = Federation(cfg, seed=0, mesh=client_mesh(8))
+    fed.set_alive(5, False)
+    m = fed.step()
+    assert int(m.num_active) == 7

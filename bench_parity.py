@@ -25,7 +25,6 @@ import jax
 
 from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
 from fedtpu.core import Federation
-from fedtpu import data
 from fedtpu.data import load
 
 
@@ -123,7 +122,8 @@ def configs(quick: bool, cpu_scale: bool = False):
     # of the vmapped resnet18 train step alone takes ~10 min on this host
     # (the zoo tests cover resnet18 correctness; tools/compile_pallas_tpu.py
     # AOT-proves the 64-client resnet18/cifar100 round step for the v5e
-    # target sharded over 4 chips — single-chip exceeds one v5e's HBM).
+    # target both sharded over 4 chips and on one chip with
+    # remat + streaming gather — naively it exceeds one v5e's HBM).
     yield mk("4_fedavg_resnet18_cifar100_64c_5ep",
              "smallcnn" if (quick or cpu_scale) else "resnet18",
              "cifar100", 64, 5, local_epochs=5)
@@ -146,7 +146,7 @@ def run_one(name: str, cfg: RoundConfig) -> dict:
     test_loss, test_acc = fed.evaluate(*test)
     return {
         "config": name,
-        "data_source": data.data_source(cfg.data.dataset),
+        "data_source": fed.data_source,
         "rounds_per_sec": round((cfg.fed.num_rounds - 1) / max(dt, 1e-9), 3),
         "train_acc": round(float(m.accuracy), 4),
         "test_acc": round(test_acc, 4),

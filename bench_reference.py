@@ -183,7 +183,7 @@ main()
 """
 
 
-def _server_round(stubs, world, workdir, proto, build, spec, compress):
+def _server_round(stubs, world, workdir, proto, build, spec):
     """One synchronous round, reference mechanics (src/server.py:120-153)."""
     import torch
 
@@ -263,8 +263,16 @@ def run_config(name, parity_cfg, note=""):
     n_clients = cfg.fed.num_clients
     gzip_on = cfg.fed.compression != "none"  # reference -c Y == gzip
     workdir = tempfile.mkdtemp(prefix="fedref_")
-    base_port = 52000
-    addresses = [f"localhost:{base_port + i}" for i in range(n_clients)]
+    # Ephemeral free-port probe per client: hard-coded ranges cross-talk
+    # with orphaned servers from a killed previous run.
+    import socket
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            return s.getsockname()[1]
+
+    addresses = [f"localhost:{_free_port()}" for _ in range(n_clients)]
 
     x, y = load(cfg.data.dataset, "train", seed=cfg.data.seed,
                 num=cfg.data.num_examples)
@@ -309,13 +317,11 @@ def run_config(name, parity_cfg, note=""):
         build = ns["build_model"]
 
         # Warmup round, then timed rounds (same shape as bench_parity).
-        _server_round(stubs, n_clients, workdir, proto, build, spec, gzip_on)
+        _server_round(stubs, n_clients, workdir, proto, build, spec)
         t0 = time.perf_counter()
         timed = cfg.fed.num_rounds - 1
         for _ in range(timed):
-            avg = _server_round(
-                stubs, n_clients, workdir, proto, build, spec, gzip_on
-            )
+            avg = _server_round(stubs, n_clients, workdir, proto, build, spec)
         dt = time.perf_counter() - t0
 
         # Test accuracy of the final global model.

@@ -110,6 +110,10 @@ class RoundConfig:
     steps_per_round: int = 8
     dtype: str = "float32"  # compute dtype for activations; params stay f32
     mesh_axis: str = "clients"
+    # Per-block rematerialisation for models that support it (resnet*):
+    # trades recompute FLOPs for HBM so big vmapped-client configs fit one
+    # chip (measured: BASELINE.md config 4 OOMs one v5e without it).
+    remat: bool = False
 
 
 DEFAULT_ROUND_CONFIG = RoundConfig()

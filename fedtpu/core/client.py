@@ -37,6 +37,8 @@ class ClientOutput(NamedTuple):
 def make_local_update(
     apply_fn: Callable,
     cfg: RoundConfig,
+    stream: bool = False,
+    image_shape: Optional[Tuple[int, ...]] = None,
 ) -> Callable:
     """Build the single-client local-epoch function.
 
@@ -49,6 +51,17 @@ def make_local_update(
     with ``xs: [steps, batch, ...]``, ``ys: [steps, batch]``,
     ``step_mask: [steps]`` (False steps are no-ops so ragged shards keep
     static shapes).
+
+    With ``stream=True`` the signature becomes
+
+        local_update(global_params, global_stats, opt_state, images, labels,
+                     takes, step_mask, rng, round_idx)
+
+    with ``takes: [steps, batch]`` int32 indices into the device-resident
+    ``images``/``labels``: each scan step gathers ITS batch only, so the
+    round never materialises the full ``[steps, batch, ...]`` tensor — the
+    HBM lever that (with remat) fits 64-client resnet18 rounds on one chip
+    (see BASELINE.md config 4 / tools/compile_pallas_tpu.py).
     """
     mu = cfg.fed.fedprox_mu if cfg.fed.algorithm == "fedprox" else 0.0
     compute_dtype = jnp.dtype(cfg.dtype)
@@ -62,7 +75,17 @@ def make_local_update(
 
             aug_rng, rng = jax.random.split(rng)
             x = augment_batch(aug_rng, x)
-        variables = {"params": params, "batch_stats": batch_stats}
+        # True mixed precision: master params stay f32 in FederatedState;
+        # casting them (not just x) at use keeps the WHOLE forward in the
+        # compute dtype — flax layers otherwise promote bf16 activations
+        # back to f32 against f32 kernels, silently doubling activation HBM
+        # and halving MXU rate. Gradients flow through the cast and come out
+        # f32. BN running stats stay f32 (they are outputs in train mode).
+        if compute_dtype != jnp.float32:
+            cast = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        else:
+            cast = params
+        variables = {"params": cast, "batch_stats": batch_stats}
         logits, updated = apply_fn(
             variables,
             x.astype(compute_dtype),
@@ -84,21 +107,16 @@ def make_local_update(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def local_update(
-        global_params: Pytree,
-        global_stats: Pytree,
-        opt_state: optim.SGDState,
-        xs: jnp.ndarray,
-        ys: jnp.ndarray,
-        step_mask: jnp.ndarray,
-        rng: jax.Array,
-        round_idx: jnp.ndarray,
+    def _run_scan(
+        global_params, global_stats, opt_state, step_elems, get_xy,
+        steps, step_mask, rng, round_idx,
     ) -> ClientOutput:
         lr = cfg.opt.lr_at(round_idx)
 
         def one_step(carry, batch):
             params, stats, ostate = carry
-            x, y, live, step_rng = batch
+            elem, live, step_rng = batch
+            x, y = get_xy(elem)
             (loss, (new_stats, ce, acc)), grads = grad_fn(
                 params, stats, global_params, x, y, step_rng
             )
@@ -118,12 +136,11 @@ def make_local_update(
             )
             return (params, stats, ostate), (ce * live_f, acc * live_f, live_f)
 
-        steps = xs.shape[0]
         step_rngs = jax.random.split(rng, steps)
         (params, stats, ostate), (ces, accs, lives) = jax.lax.scan(
             one_step,
             (global_params, global_stats, opt_state),
-            (xs, ys, step_mask, step_rngs),
+            (step_elems, step_mask, step_rngs),
         )
         n = jnp.maximum(jnp.sum(lives), 1.0)
         return ClientOutput(
@@ -134,6 +151,55 @@ def make_local_update(
             accuracy=jnp.sum(accs) / n,
             num_steps=jnp.sum(lives),
         )
+
+    if stream:
+        shape = tuple(image_shape or cfg.image_size)
+
+        def local_update(
+            global_params: Pytree,
+            global_stats: Pytree,
+            opt_state: optim.SGDState,
+            images: jnp.ndarray,
+            labels: jnp.ndarray,
+            takes: jnp.ndarray,
+            step_mask: jnp.ndarray,
+            rng: jax.Array,
+            round_idx: jnp.ndarray,
+        ) -> ClientOutput:
+            # Each scan step gathers only its own [batch]-sized slice from
+            # the device-resident dataset — nothing [steps, batch, ...]-sized
+            # ever exists. The dataset may arrive FLATTENED ([N, H*W*C]):
+            # NHWC image tensors pad ~4x under TPU tiled layouts, flat rows
+            # tile exactly; the per-batch reshape after the gather is free.
+            def get_xy(t):
+                x = images[t]
+                if x.ndim == 2:
+                    x = x.reshape((t.shape[0],) + shape)
+                return x, labels[t]
+
+            return _run_scan(
+                global_params, global_stats, opt_state,
+                takes, get_xy,
+                takes.shape[0], step_mask, rng, round_idx,
+            )
+
+    else:
+
+        def local_update(
+            global_params: Pytree,
+            global_stats: Pytree,
+            opt_state: optim.SGDState,
+            xs: jnp.ndarray,
+            ys: jnp.ndarray,
+            step_mask: jnp.ndarray,
+            rng: jax.Array,
+            round_idx: jnp.ndarray,
+        ) -> ClientOutput:
+            return _run_scan(
+                global_params, global_stats, opt_state,
+                (xs, ys), lambda e: e,
+                xs.shape[0], step_mask, rng, round_idx,
+            )
 
     return local_update
 

@@ -78,7 +78,9 @@ class Federation:
         # steps_per_round passes over the shard (make_client_batches wraps
         # short shards), matching the reference's epochs-per-StartTrain knob.
         self._steps = cfg.steps_per_round * max(1, cfg.fed.local_epochs)
-        self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
+        self.model = model_zoo.create(
+            cfg.model, num_classes=cfg.num_classes, remat=cfg.remat
+        )
 
         if data is None:
             images, labels = load(
@@ -114,13 +116,15 @@ class Federation:
             self.model, cfg, jax.random.PRNGKey(seed), sample, compressor
         )
         shuffle = cfg.data.partition != "round_robin"
+        img_shape = tuple(images.shape[1:])
         if mesh is None:
             self._round_step = jax.jit(
                 make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
             )
             self._data_step = jax.jit(
                 make_data_round_step(
-                    self.model, cfg, self._steps, compressor, shuffle=shuffle
+                    self.model, cfg, self._steps, compressor, shuffle=shuffle,
+                    image_shape=img_shape,
                 ),
                 donate_argnums=(0,),
             )
@@ -135,7 +139,8 @@ class Federation:
                 self.model, cfg, mesh, compressor
             )
             self._data_step = make_sharded_data_round_step(
-                self.model, cfg, self._steps, mesh, compressor, shuffle=shuffle
+                self.model, cfg, self._steps, mesh, compressor, shuffle=shuffle,
+                image_shape=img_shape,
             )
             self.state = shard_state(self.state, mesh, cfg.mesh_axis)
             self.weights = self._placed(self.weights, sharded=True)
@@ -161,9 +166,15 @@ class Federation:
     def _ensure_device_data(self):
         if self._device_data is None:
             # Dataset replicated (every device gathers its own clients'
-            # batches locally); assignment matrix sharded by client.
+            # batches locally); assignment matrix sharded by client. Images
+            # live FLAT ([N, H*W*C]): NHWC tensors pad ~4x under TPU tiled
+            # layouts, flat rows tile exactly — the per-batch reshape after
+            # the gather is free.
+            flat = np.asarray(self.images, np.float32).reshape(
+                len(self.images), -1
+            )
             self._device_data = (
-                self._placed(np.asarray(self.images, np.float32), sharded=False),
+                self._placed(flat, sharded=False),
                 self._placed(np.asarray(self.labels, np.int32), sharded=False),
                 self._placed(self.client_idx, sharded=True),
                 self._placed(self.client_mask, sharded=True),

@@ -141,7 +141,9 @@ def make_round_step(
     cfg: RoundConfig,
     compressor=None,  # Optional[fedtpu.ops.compression.Compressor]
     axis_name: Optional[str] = None,
-) -> Callable[[FederatedState, RoundBatch], Tuple[FederatedState, RoundMetrics]]:
+    stream: bool = False,
+    image_shape: Optional[Tuple[int, ...]] = None,
+) -> Callable[..., Tuple[FederatedState, RoundMetrics]]:
     """Build the round step.
 
     With ``axis_name=None`` this is the single-program (vmap-only) form. With
@@ -152,15 +154,33 @@ def make_round_step(
     ``compressor``, when given, is a stateful delta codec
     (:class:`fedtpu.ops.compression.Compressor`) — the ``-c Y`` parity path;
     its error-feedback residuals ride in ``state.comp_state``.
+
+    With ``stream=True`` the returned function is
+    ``round_step(state, images, labels, batch)`` where ``batch.x`` holds
+    int32 gather indices ``[clients, steps, batch]`` into the device-resident
+    dataset (``batch.y`` is ignored); each scan step gathers only its own
+    batch, so nothing ``[clients, steps, batch, ...]``-sized is ever
+    materialised — see :mod:`fedtpu.data.device`.
     """
-    local_update = make_local_update(model.apply, cfg)
-    vmapped = jax.vmap(
-        local_update,
-        in_axes=(None, None, 0, 0, 0, 0, 0, None),
+    local_update = make_local_update(
+        model.apply, cfg, stream=stream, image_shape=image_shape
     )
+    if stream:
+        vmapped = jax.vmap(
+            local_update,
+            in_axes=(None, None, 0, None, None, 0, 0, 0, None),
+        )
+    else:
+        vmapped = jax.vmap(
+            local_update,
+            in_axes=(None, None, 0, 0, 0, 0, 0, None),
+        )
 
     def round_step(
-        state: FederatedState, batch: RoundBatch
+        state: FederatedState,
+        batch: RoundBatch,
+        images: Optional[jnp.ndarray] = None,
+        labels: Optional[jnp.ndarray] = None,
     ) -> Tuple[FederatedState, RoundMetrics]:
         n = batch.alive.shape[0]
         rngs = jax.vmap(jax.random.fold_in)(
@@ -169,16 +189,29 @@ def make_round_step(
         # Dead clients also get their steps masked out: they do no local work,
         # mirroring a crashed reference client that never receives StartTrain.
         step_mask = batch.step_mask & batch.alive[:, None]
-        out: ClientOutput = vmapped(
-            state.params,
-            state.batch_stats,
-            state.opt_state,
-            batch.x,
-            batch.y,
-            step_mask,
-            rngs,
-            state.round_idx,
-        )
+        if stream:
+            out: ClientOutput = vmapped(
+                state.params,
+                state.batch_stats,
+                state.opt_state,
+                images,
+                labels,
+                batch.x,
+                step_mask,
+                rngs,
+                state.round_idx,
+            )
+        else:
+            out = vmapped(
+                state.params,
+                state.batch_stats,
+                state.opt_state,
+                batch.x,
+                batch.y,
+                step_mask,
+                rngs,
+                state.round_idx,
+            )
 
         if cfg.fed.weighted:
             agg_w = batch.weights * batch.alive.astype(batch.weights.dtype)

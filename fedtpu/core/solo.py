@@ -46,7 +46,9 @@ class SoloTrainer:
         resume: bool = False,
     ):
         self.cfg = cfg
-        self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
+        self.model = model_zoo.create(
+            cfg.model, num_classes=cfg.num_classes, remat=cfg.remat
+        )
         self.images, self.labels = load(
             cfg.data.dataset, "train", seed=cfg.data.seed, num=cfg.data.num_examples
         )

@@ -66,6 +66,8 @@ def make_data_round_step(
     compressor=None,
     shuffle: bool = True,
     axis_name: Optional[str] = None,
+    stream: Optional[bool] = None,
+    image_shape: Optional[Tuple[int, ...]] = None,
 ) -> Callable[..., Tuple[FederatedState, RoundMetrics]]:
     """Round step that gathers its own batches from the device-resident
     dataset: ``step(state, images, labels, idx, mask, weights, alive,
@@ -78,8 +80,21 @@ def make_data_round_step(
     ``mask``, ``weights`` and ``alive`` are then the LOCAL client rows while
     ``images``/``labels`` are replicated, so each device gathers only its own
     clients' batches and aggregation psums over the mesh.
+
+    ``stream`` (default: ``cfg.remat``, since both matter for the same
+    big-model configs): gather each step's batch INSIDE the training scan
+    instead of materialising all ``[clients, steps, batch, ...]`` up front —
+    the full tensor never exists in HBM, only per-step batches. Numerically
+    identical; the default stays off for small models where one big fused
+    gather is faster.
     """
-    base = make_round_step(model, cfg, compressor, axis_name=axis_name)
+    if stream is None:
+        stream = cfg.remat
+    shape = tuple(image_shape or cfg.image_size)
+    base = make_round_step(
+        model, cfg, compressor, axis_name=axis_name, stream=stream,
+        image_shape=shape,
+    )
     batch_size = cfg.data.batch_size
     need = steps * batch_size
 
@@ -103,10 +118,19 @@ def make_data_round_step(
                 # the same per-row permutation pattern).
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
         take = round_take_indices(idx, mask, need, rng)
-        x = images[take].reshape((n, steps, batch_size) + images.shape[1:])
-        y = labels[take].reshape((n, steps, batch_size))
         has_data = mask.any(axis=1)
         step_mask = jnp.broadcast_to(has_data[:, None], (n, steps))
+        if stream:
+            takes = take.reshape((n, steps, batch_size))
+            batch = RoundBatch(
+                x=takes, y=takes, step_mask=step_mask, weights=weights,
+                alive=alive,
+            )
+            return base(state, batch, images, labels)
+        # Dataset may be stored flat ([N, H*W*C] — the TPU-friendly layout);
+        # reshape the gathered batch back to images either way.
+        x = images[take].reshape((n, steps, batch_size) + shape)
+        y = labels[take].reshape((n, steps, batch_size))
         batch = RoundBatch(
             x=x, y=y, step_mask=step_mask, weights=weights, alive=alive
         )
@@ -123,6 +147,8 @@ def make_sharded_data_round_step(
     compressor=None,
     shuffle: bool = True,
     donate: bool = True,
+    stream: Optional[bool] = None,
+    image_shape: Optional[Tuple[int, ...]] = None,
 ):
     """Mesh-parallel round step with the on-device gather inside each shard.
 
@@ -145,7 +171,8 @@ def make_sharded_data_round_step(
             f"{mesh.devices.size}"
         )
     body = make_data_round_step(
-        model, cfg, steps, compressor, shuffle=shuffle, axis_name=axis
+        model, cfg, steps, compressor, shuffle=shuffle, axis_name=axis,
+        stream=stream, image_shape=image_shape,
     )
     sharded = jax.shard_map(
         body,

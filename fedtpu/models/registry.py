@@ -36,7 +36,21 @@ def create(name: str, num_classes: int = 10, **kwargs) -> nn.Module:
         raise KeyError(
             f"unknown model '{name}'; available: {sorted(_REGISTRY)}"
         )
-    return _REGISTRY[key](num_classes=num_classes, **kwargs)
+    ctor = _REGISTRY[key]
+    if "remat" in kwargs:
+        import inspect
+
+        if "remat" not in inspect.signature(ctor).parameters:
+            if kwargs["remat"]:
+                raise ValueError(
+                    f"model '{name}' does not support remat; models that do: "
+                    + str([
+                        n for n, c in sorted(_REGISTRY.items())
+                        if "remat" in inspect.signature(c).parameters
+                    ])
+                )
+            kwargs.pop("remat")  # remat=False is a no-op everywhere
+    return ctor(num_classes=num_classes, **kwargs)
 
 
 def available() -> list[str]:

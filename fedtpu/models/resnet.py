@@ -63,40 +63,58 @@ class ResNetModule(nn.Module):
     block: Type[nn.Module]
     num_blocks: Sequence[int]
     num_classes: int = 10
+    # Per-block rematerialisation: backward recomputes each residual block
+    # instead of storing its activations — the standard TPU FLOPs-for-HBM
+    # trade. Measured to matter: the 64-client CIFAR-100 federated round
+    # (BASELINE.md config 4) exceeds one v5e's 16 GB HBM without it.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = conv3x3(64)(x)
         x = batch_norm(train)(x)
         x = nn.relu(x)
+        count = 0
         for stage, (features, n) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
             for i in range(n):
                 stride = (1 if stage == 0 else 2) if i == 0 else 1
-                x = self.block(features=features, stride=stride)(x, train=train)
+                blk = self.block
+                if self.remat:
+                    # static_argnums counts self: (self, x, train) -> 2.
+                    blk = nn.remat(blk, static_argnums=(2,))
+                # Explicit name keeps params/checkpoints identical whether or
+                # not remat is on (nn.remat would otherwise rename modules to
+                # Checkpoint<Block>_N, splitting the RNG tree differently).
+                x = blk(
+                    features=features,
+                    stride=stride,
+                    name=f"{self.block.__name__}_{count}",
+                )(x, train)
+                count += 1
         x = global_avg_pool(x)
         return nn.Dense(self.num_classes)(x)
 
 
 @register("resnet18")
-def ResNet18(num_classes: int = 10) -> nn.Module:
-    return ResNetModule(BasicBlock, (2, 2, 2, 2), num_classes)
+def ResNet18(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return ResNetModule(BasicBlock, (2, 2, 2, 2), num_classes, remat)
 
 
 @register("resnet34")
-def ResNet34(num_classes: int = 10) -> nn.Module:
-    return ResNetModule(BasicBlock, (3, 4, 6, 3), num_classes)
+def ResNet34(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return ResNetModule(BasicBlock, (3, 4, 6, 3), num_classes, remat)
 
 
 @register("resnet50")
-def ResNet50(num_classes: int = 10) -> nn.Module:
-    return ResNetModule(Bottleneck, (3, 4, 6, 3), num_classes)
+def ResNet50(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 4, 6, 3), num_classes, remat)
 
 
 @register("resnet101")
-def ResNet101(num_classes: int = 10) -> nn.Module:
-    return ResNetModule(Bottleneck, (3, 4, 23, 3), num_classes)
+def ResNet101(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 4, 23, 3), num_classes, remat)
 
 
 @register("resnet152")
-def ResNet152(num_classes: int = 10) -> nn.Module:
-    return ResNetModule(Bottleneck, (3, 8, 36, 3), num_classes)
+def ResNet152(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return ResNetModule(Bottleneck, (3, 8, 36, 3), num_classes, remat)

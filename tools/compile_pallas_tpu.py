@@ -93,7 +93,7 @@ def _bench_inputs(cfg, sharding_for, compressor=None):
     from fedtpu.core import round as round_lib
     from fedtpu import models
 
-    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    model = models.create(cfg.model, num_classes=cfg.num_classes, remat=cfg.remat)
     state = jax.eval_shape(
         lambda r: round_lib.init_state(
             model, cfg, r, jnp.zeros((1, 32, 32, 3), jnp.float32), compressor
@@ -126,6 +126,7 @@ def compile_round_step(
     steps=391 // NUM_CLIENTS,
     batch=128,
     tag="bench_config",
+    remat=False,
 ):
     """bench.py's exact single-chip config (optionally with the ``-c Y``
     top-k compression path, whose Pallas kernels then compile *inside* the
@@ -144,6 +145,7 @@ def compile_round_step(
         fed=FedConfig(num_clients=NUM_CLIENTS, compression=compression),
         steps_per_round=steps,
         dtype="bfloat16",
+        remat=remat,
     )
     compressor = None
     if compression != "none":
@@ -168,10 +170,87 @@ def compile_round_step(
     compiled = step.lower(same(state), same(batch)).compile()
     return {
         "artifact": f"round_step:{tag}_single_chip"
-        + ("" if compression == "none" else f"_{compression}"),
+        + ("" if compression == "none" else f"_{compression}")
+        + ("_remat" if remat else ""),
         "target": dev.device_kind,
         "model": model_name,
         "num_clients": NUM_CLIENTS,
+        "compile_s": round(time.perf_counter() - t0, 2),
+        "flops_per_round": _flops(compiled),
+        "ok": True,
+        **_mem(compiled),
+    }
+
+
+def compile_streaming_round_step(
+    dev,
+    model_name="resnet18",
+    dataset="cifar100",
+    num_classes=100,
+    steps=40,
+    batch=32,
+    remat=True,
+    tag="parity4_resnet18_cifar100_stream",
+):
+    """The engine's actual big-model path on ONE chip: device-resident
+    dataset, per-step gather inside the scan (``stream``), per-block remat.
+    This is the configuration that brings 64-client resnet18 rounds back
+    under one v5e's HBM after the non-stream form measurably OOMed."""
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core import round as round_lib
+    from fedtpu.data.device import make_data_round_step
+    from fedtpu import models
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=num_classes,
+        opt=OptimizerConfig(),
+        data=DataConfig(dataset=dataset, batch_size=batch),
+        fed=FedConfig(num_clients=NUM_CLIENTS),
+        steps_per_round=steps,
+        dtype="bfloat16",
+        remat=remat,
+    )
+    model = models.create(cfg.model, num_classes=cfg.num_classes, remat=cfg.remat)
+    state = jax.eval_shape(
+        lambda r: round_lib.init_state(
+            model, cfg, r, jnp.zeros((1, 32, 32, 3), jnp.float32)
+        ),
+        jax.random.PRNGKey(0),
+    )
+    s = jax.sharding.SingleDeviceSharding(dev)
+    sds = lambda shape, dtype: jax.ShapeDtypeStruct(shape, dtype, sharding=s)
+    place = lambda tree: jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        tree,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    n, total, shard = NUM_CLIENTS, 50000, 50000 // NUM_CLIENTS
+    step_fn = jax.jit(
+        make_data_round_step(
+            model, cfg, steps, shuffle=True, stream=True,
+            image_shape=(32, 32, 3),
+        ),
+        donate_argnums=(0,),
+    )
+    t0 = time.perf_counter()
+    compiled = step_fn.lower(
+        place(state),
+        sds((total, 32 * 32 * 3), jnp.float32),  # flat dataset in HBM
+        sds((total,), jnp.int32),
+        sds((n, shard), jnp.int32),
+        sds((n, shard), jnp.bool_),
+        sds((n,), jnp.float32),
+        sds((n,), jnp.bool_),
+        sds((2,), jnp.uint32),  # data key
+    ).compile()
+    return {
+        "artifact": f"round_step:{tag}_single_chip",
+        "target": dev.device_kind,
+        "model": model_name,
+        "num_clients": NUM_CLIENTS,
+        "remat": remat,
+        "stream": True,
         "compile_s": round(time.perf_counter() - t0, 2),
         "flops_per_round": _flops(compiled),
         "ok": True,
@@ -206,7 +285,7 @@ def compile_sharded_round_step(
     mesh = Mesh(np.array(topo.devices), (cfg.mesh_axis,))
     from fedtpu import models
 
-    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    model = models.create(cfg.model, num_classes=cfg.num_classes, remat=cfg.remat)
     _, state, batch, _ = _bench_inputs(cfg, None)
     state_in = _with_specs(state, state_specs(cfg.mesh_axis), mesh)
     batch_in = _with_specs(batch, batch_specs(cfg.mesh_axis), mesh)
@@ -258,11 +337,13 @@ def main():
         lambda: compile_kernels(dev),
         lambda: [compile_round_step(dev)],
         lambda: [compile_round_step(dev, compression="topk")],
-        # Parity config 4's TPU-side evidence: 64-client resnet18/cifar100
-        # compiles for the v5e target SHARDED over 4 chips (16 clients per
-        # chip). The single-chip form genuinely exceeds one v5e's HBM at
-        # these shapes — a real capacity result, recorded in BASELINE.md —
-        # so the deployment shape is the mesh one.
+        # Parity config 4's TPU-side evidence, two deployment shapes:
+        # (a) single chip with per-block remat + per-step streaming gather —
+        #     the engine's actual big-model path. Without these, this config
+        #     measurably exceeds one v5e's 16 GB HBM (capacity result
+        #     recorded in BASELINE.md);
+        # (b) SHARDED over 4 chips (16 clients per chip), no remat needed.
+        lambda: [compile_streaming_round_step(dev)],
         lambda: [
             compile_sharded_round_step(
                 topo,

@@ -264,15 +264,19 @@ def run_config(name, parity_cfg, note=""):
     gzip_on = cfg.fed.compression != "none"  # reference -c Y == gzip
     workdir = tempfile.mkdtemp(prefix="fedref_")
     # Ephemeral free-port probe per client: hard-coded ranges cross-talk
-    # with orphaned servers from a killed previous run.
+    # with orphaned servers from a killed previous run. All probe sockets are
+    # held open while probing so the kernel cannot hand the same port to two
+    # clients, then released together right before the child binds.
     import socket
 
-    def _free_port():
-        with socket.socket() as s:
-            s.bind(("localhost", 0))
-            return s.getsockname()[1]
-
-    addresses = [f"localhost:{_free_port()}" for _ in range(n_clients)]
+    probes = []
+    for _ in range(n_clients):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        probes.append(s)
+    addresses = [f"localhost:{s.getsockname()[1]}" for s in probes]
+    for s in probes:
+        s.close()
 
     x, y = load(cfg.data.dataset, "train", seed=cfg.data.seed,
                 num=cfg.data.num_examples)

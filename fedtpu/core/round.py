@@ -156,7 +156,7 @@ def make_round_step(
     its error-feedback residuals ride in ``state.comp_state``.
 
     With ``stream=True`` the returned function is
-    ``round_step(state, images, labels, batch)`` where ``batch.x`` holds
+    ``round_step(state, batch, images, labels)`` where ``batch.x`` holds
     int32 gather indices ``[clients, steps, batch]`` into the device-resident
     dataset (``batch.y`` is ignored); each scan step gathers only its own
     batch, so nothing ``[clients, steps, batch, ...]``-sized is ever

@@ -127,9 +127,10 @@ def make_data_round_step(
                 alive=alive,
             )
             return base(state, batch, images, labels)
-        # Dataset may be stored flat ([N, H*W*C] — the TPU-friendly layout);
-        # reshape the gathered batch back to images either way.
-        x = images[take].reshape((n, steps, batch_size) + shape)
+        # Dataset may be stored flat ([N, H*W*C] — the TPU-friendly layout,
+        # reshaped back via image_shape) or as images (shape from the array).
+        tail = shape if images.ndim == 2 else tuple(images.shape[1:])
+        x = images[take].reshape((n, steps, batch_size) + tail)
         y = labels[take].reshape((n, steps, batch_size))
         batch = RoundBatch(
             x=x, y=y, step_mask=step_mask, weights=weights, alive=alive

@@ -31,6 +31,18 @@ def batch_norm(train: bool) -> nn.Module:
     return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)
 
 
+def maybe_remat(block_cls, remat: bool):
+    """Per-block rematerialisation wrapper (the HBM-for-FLOPs trade; see
+    ``RoundConfig.remat``). ``static_argnums=(2,)`` marks the ``train`` flag
+    static in ``__call__(self, x, train)``. Callers MUST pin the module
+    ``name=`` explicitly: ``nn.remat`` renames modules to
+    ``Checkpoint<Block>_N``, which would split the init RNG tree differently
+    and break checkpoint compatibility with the non-remat form."""
+    if not remat:
+        return block_cls
+    return nn.remat(block_cls, static_argnums=(2,))
+
+
 def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
     """Mean over the spatial dims of an NHWC tensor."""
     return jnp.mean(x, axis=(1, 2))

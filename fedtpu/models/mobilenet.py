@@ -13,7 +13,13 @@ from typing import Sequence, Tuple, Union
 
 import flax.linen as nn
 
-from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.common import (
+    batch_norm,
+    conv1x1,
+    conv3x3,
+    global_avg_pool,
+    maybe_remat,
+)
 from fedtpu.models.registry import register
 
 _CFG: Sequence[Union[int, Tuple[int, int]]] = (
@@ -59,19 +65,22 @@ class DepthwiseSeparable(nn.Module):
 
 class MobileNetModule(nn.Module):
     num_classes: int = 10
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = conv3x3(32, strides=(1, 1))(x)
         x = batch_norm(train)(x)
         x = nn.relu(x)
-        for entry in _CFG:
+        for count, entry in enumerate(_CFG):
             features, stride = (entry, 1) if isinstance(entry, int) else entry
-            x = DepthwiseSeparable(features, stride)(x, train=train)
+            x = maybe_remat(DepthwiseSeparable, self.remat)(
+                features, stride, name=f"DepthwiseSeparable_{count}"
+            )(x, train)
         x = global_avg_pool(x)
         return nn.Dense(self.num_classes)(x)
 
 
 @register("mobilenet")
-def MobileNet(num_classes: int = 10) -> nn.Module:
-    return MobileNetModule(num_classes=num_classes)
+def MobileNet(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return MobileNetModule(num_classes=num_classes, remat=remat)

@@ -13,7 +13,7 @@ from typing import Sequence, Type
 
 import flax.linen as nn
 
-from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.common import maybe_remat, batch_norm, conv1x1, conv3x3, global_avg_pool
 from fedtpu.models.registry import register
 
 
@@ -61,40 +61,47 @@ class PreActResNetModule(nn.Module):
     block: Type[nn.Module]
     num_blocks: Sequence[int]
     num_classes: int = 10
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = conv3x3(64)(x)
+        count = 0
         for stage, (features, n) in enumerate(
             zip((64, 128, 256, 512), self.num_blocks)
         ):
             for i in range(n):
                 stride = (1 if stage == 0 else 2) if i == 0 else 1
-                x = self.block(features=features, stride=stride)(x, train=train)
+                x = maybe_remat(self.block, self.remat)(
+                    features=features,
+                    stride=stride,
+                    name=f"{self.block.__name__}_{count}",
+                )(x, train)
+                count += 1
         x = global_avg_pool(x)
         return nn.Dense(self.num_classes)(x)
 
 
 @register("preactresnet18")
-def PreActResNet18(num_classes: int = 10) -> nn.Module:
-    return PreActResNetModule(PreActBlock, (2, 2, 2, 2), num_classes)
+def PreActResNet18(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return PreActResNetModule(PreActBlock, (2, 2, 2, 2), num_classes, remat)
 
 
 @register("preactresnet34")
-def PreActResNet34(num_classes: int = 10) -> nn.Module:
-    return PreActResNetModule(PreActBlock, (3, 4, 6, 3), num_classes)
+def PreActResNet34(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return PreActResNetModule(PreActBlock, (3, 4, 6, 3), num_classes, remat)
 
 
 @register("preactresnet50")
-def PreActResNet50(num_classes: int = 10) -> nn.Module:
-    return PreActResNetModule(PreActBottleneck, (3, 4, 6, 3), num_classes)
+def PreActResNet50(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 4, 6, 3), num_classes, remat)
 
 
 @register("preactresnet101")
-def PreActResNet101(num_classes: int = 10) -> nn.Module:
-    return PreActResNetModule(PreActBottleneck, (3, 4, 23, 3), num_classes)
+def PreActResNet101(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 4, 23, 3), num_classes, remat)
 
 
 @register("preactresnet152")
-def PreActResNet152(num_classes: int = 10) -> nn.Module:
-    return PreActResNetModule(PreActBottleneck, (3, 8, 36, 3), num_classes)
+def PreActResNet152(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return PreActResNetModule(PreActBottleneck, (3, 8, 36, 3), num_classes, remat)

@@ -12,7 +12,13 @@ from typing import Sequence, Type
 
 import flax.linen as nn
 
-from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.common import (
+    batch_norm,
+    conv1x1,
+    conv3x3,
+    global_avg_pool,
+    maybe_remat,
+)
 from fedtpu.models.registry import register
 
 
@@ -78,14 +84,9 @@ class ResNetModule(nn.Module):
         for stage, (features, n) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
             for i in range(n):
                 stride = (1 if stage == 0 else 2) if i == 0 else 1
-                blk = self.block
-                if self.remat:
-                    # static_argnums counts self: (self, x, train) -> 2.
-                    blk = nn.remat(blk, static_argnums=(2,))
                 # Explicit name keeps params/checkpoints identical whether or
-                # not remat is on (nn.remat would otherwise rename modules to
-                # Checkpoint<Block>_N, splitting the RNG tree differently).
-                x = blk(
+                # not remat is on (see common.maybe_remat).
+                x = maybe_remat(self.block, self.remat)(
                     features=features,
                     stride=stride,
                     name=f"{self.block.__name__}_{count}",

@@ -11,7 +11,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtpu.models.common import batch_norm, conv1x1, conv3x3, global_avg_pool
+from fedtpu.models.common import maybe_remat, batch_norm, conv1x1, conv3x3, global_avg_pool
 from fedtpu.models.registry import register
 
 
@@ -50,21 +50,26 @@ class SEPreActBlock(nn.Module):
 class SENetModule(nn.Module):
     num_blocks: tuple = (2, 2, 2, 2)
     num_classes: int = 10
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = conv3x3(64)(x)
         x = nn.relu(batch_norm(train)(x))
+        count = 0
         for stage, (features, n) in enumerate(
             zip((64, 128, 256, 512), self.num_blocks)
         ):
             for i in range(n):
                 stride = (1 if stage == 0 else 2) if i == 0 else 1
-                x = SEPreActBlock(features, stride)(x, train=train)
+                x = maybe_remat(SEPreActBlock, self.remat)(
+                    features, stride, name=f"SEPreActBlock_{count}"
+                )(x, train)
+                count += 1
         x = global_avg_pool(x)
         return nn.Dense(self.num_classes)(x)
 
 
 @register("senet18")
-def SENet18(num_classes: int = 10) -> nn.Module:
-    return SENetModule((2, 2, 2, 2), num_classes)
+def SENet18(num_classes: int = 10, remat: bool = False) -> nn.Module:
+    return SENetModule((2, 2, 2, 2), num_classes, remat)

@@ -207,6 +207,6 @@ def test_remat_resnet_params_and_grads_match(rng):
 def test_remat_unsupported_model_raises():
     from fedtpu import models
     with pytest.raises(ValueError, match="does not support remat"):
-        models.create("mobilenet", num_classes=10, remat=True)
+        models.create("lenet", num_classes=10, remat=True)
     # remat=False is accepted everywhere (a no-op).
-    models.create("mobilenet", num_classes=10, remat=False)
+    models.create("lenet", num_classes=10, remat=False)

@@ -337,6 +337,13 @@ def main():
         lambda: compile_kernels(dev),
         lambda: [compile_round_step(dev)],
         lambda: [compile_round_step(dev, compression="topk")],
+        # The flagship model (MobileNet — the reference's hardcoded default,
+        # src/main.py:69) at the bench scale, single chip.
+        lambda: [
+            compile_round_step(
+                dev, model_name="mobilenet", tag="flagship_mobilenet"
+            )
+        ],
         # Parity config 4's TPU-side evidence, two deployment shapes:
         # (a) single chip with per-block remat + per-step streaming gather —
         #     the engine's actual big-model path. Without these, this config

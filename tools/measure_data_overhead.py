@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Evidence that the steady-state round is compute-bound.
+
+Times three ways of feeding the same federated round (same model, same
+config, same data):
+
+  compute_only   — a fixed pre-built RoundBatch reused every round: pure
+                   device compute, the floor.
+  device_gather  — the production path (Federation.step with batch=None):
+                   HBM-resident dataset, per-round gather inside the jitted
+                   program.
+  host_rebuild   — the pre-round-3 path: numpy fancy-indexing rebuilds every
+                   client's batch tensors on the host each round, then
+                   transfers.
+
+The claim "per-round host data preparation no longer gates throughput" holds
+iff device_gather ~= compute_only while host_rebuild is materially slower.
+Writes one JSON line (and --out file). CPU-safe; on TPU the same script
+measures the real thing.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+ROUNDS = 20
+
+
+def _time(fn, rounds=ROUNDS):
+    fn()  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        fn()
+    return (time.perf_counter() - t0) / rounds
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--clients", type=int, default=64)
+    # Defaults mirror bench.py's shapes: 6 steps x 128 images per client per
+    # round — the sizing at which the host rebuild moves ~600 MB per round.
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        choices=["cpu", "tpu", "cuda"],
+        help="jax platform to measure on (default cpu: this container's "
+        "env-default TPU backend can hang; pass 'tpu' explicitly to measure "
+        "the real thing)",
+    )
+    args = p.parse_args()
+    jax.config.update("jax_platforms", args.platform)
+
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core import Federation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05),
+        data=DataConfig(dataset="synthetic", batch_size=args.batch,
+                        partition="iid", num_examples=64 * args.clients),
+        fed=FedConfig(num_clients=args.clients),
+        steps_per_round=args.steps,
+    )
+
+    fed = Federation(cfg, seed=0)
+    fixed = fed.round_batch(0)
+
+    def compute_only():
+        m = fed.step(fixed)
+        float(m.loss)
+
+    def device_gather():
+        m = fed.step()
+        float(m.loss)
+
+    def host_rebuild():
+        r = fed._round_number()
+        m = fed.step(fed.round_batch(r))
+        float(m.loss)
+
+    result = {
+        "metric": "seconds_per_round",
+        "clients": args.clients,
+        "compute_only": round(_time(compute_only), 5),
+        "device_gather": round(_time(device_gather), 5),
+        "host_rebuild": round(_time(host_rebuild), 5),
+        "platform": jax.default_backend(),
+    }
+    result["gather_overhead_vs_compute"] = round(
+        result["device_gather"] / result["compute_only"] - 1, 4
+    )
+    result["host_rebuild_slowdown"] = round(
+        result["host_rebuild"] / result["device_gather"], 2
+    )
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(result, fh, indent=1)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

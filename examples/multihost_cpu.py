@@ -49,10 +49,46 @@ NUM_PROCESSES = 2
 NUM_CLIENTS = 8
 
 
+def run_engine(args, n_dev):
+    """Drive the high-level engine across both processes: Federation with a
+    global mesh — per-client state and assignment sharded, dataset
+    replicated, the on-device gather + psum FedAvg in one shard_map program
+    per round. Every host executes the same code; only process 0 would do
+    IO in a real deployment (multihost.is_coordinator)."""
+    from fedtpu.core import Federation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=NUM_CLIENTS),
+        steps_per_round=2,
+    )
+    fed = Federation(cfg, seed=0, mesh=client_mesh(axis_name=cfg.mesh_axis))
+    losses = []
+    for _ in range(3):
+        m = fed.step()
+        losses.append(round(float(m.loss), 6))
+    assert int(m.num_active) == NUM_CLIENTS
+    assert losses[-1] < losses[0], losses
+    print(
+        f"multihost engine ok: process {args.process_id}/{NUM_PROCESSES}, "
+        f"{n_dev} global devices, losses={losses}",
+        flush=True,
+    )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--process-id", type=int, required=True)
     p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--engine", action="store_true",
+                   help="drive Federation(mesh=...) instead of the raw "
+                   "sharded round step")
     args = p.parse_args()
 
     multihost.initialize(
@@ -63,6 +99,8 @@ def main():
     assert jax.process_count() == NUM_PROCESSES, jax.process_count()
     n_dev = len(jax.devices())
     assert n_dev == 4 * NUM_PROCESSES, n_dev
+    if args.engine:
+        return run_engine(args, n_dev)
 
     cfg = RoundConfig(
         model="mlp",

@@ -24,14 +24,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(port: int):
+def _launch(port: int, extra=()):
     env = dict(os.environ)
     # The child pins its own platform/device count; scrub ours so the
     # conftest's 8-device flag doesn't leak in.
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, _SCRIPT, "--process-id", str(i), "--port", str(port)],
+            [sys.executable, _SCRIPT, "--process-id", str(i), "--port", str(port)]
+            + list(extra),
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -51,18 +52,34 @@ def _launch(port: int):
     return outs
 
 
-def test_two_process_distributed_round():
-    # The free-port probe is inherently racy (the socket closes before the
-    # coordinator binds it), so a failed attempt retries once on a new port.
+def _run_and_check(marker: str, agree_key: str, extra=()):
+    """Launch both controllers, assert success + ``marker`` in each output,
+    and assert both agree on the ``agree_key``-tagged value (same psum
+    result). The free-port probe is inherently racy (the socket closes
+    before the coordinator binds it), so a failed attempt retries once on a
+    new port."""
     for attempt in range(2):
-        outs = _launch(_free_port())
+        outs = _launch(_free_port(), extra=extra)
         if all(rc == 0 for rc, _, _ in outs) or attempt == 1:
             break
     for rc, out, err in outs:
         assert rc == 0, f"child failed (rc={rc}):\n{out}\n{err}"
-        assert "multihost ok" in out, out
+        assert marker in out, out
+    agreed = {line.split(agree_key)[1] for rc, out, _ in outs
+              for line in out.splitlines() if agree_key in line}
+    assert len(agreed) == 1, agreed
+    return outs
+
+
+def test_two_process_distributed_round():
+    outs = _run_and_check("multihost ok", "loss=")
+    for _, out, _ in outs:
         assert "8 global devices" in out, out
-    # Both controllers must agree on the aggregated loss (same psum result).
-    losses = {line.split("loss=")[1] for rc, out, _ in outs
-              for line in out.splitlines() if "loss=" in line}
-    assert len(losses) == 1, losses
+
+
+def test_two_process_federation_engine():
+    """The high-level Federation engine itself over two controllers: mesh
+    spanning both processes, sharded per-client state, on-device gather,
+    cross-process psum FedAvg, converging loss — and both controllers agree
+    on every round's aggregate."""
+    _run_and_check("multihost engine ok", "losses=", extra=["--engine"])

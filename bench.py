@@ -54,6 +54,12 @@ UNIT = "client-epochs/sec/chip"
 ATTEMPT_TIMEOUT_S = 1200  # first jit on the tunnel chip can take minutes
 ATTEMPTS = 3
 BACKOFF_S = 20
+# Cheap reachability preflight: a bare jax.devices() against the tunnel
+# backend either returns in seconds or wedges forever (observed: >180 s).
+# Probing first turns a dead-relay run into a ~10-minute diagnostic instead
+# of burning all three 20-minute measurement attempts.
+PROBE_TIMEOUT_S = 240
+PROBE_ATTEMPTS = 2
 
 # Peak bf16 FLOPs/sec per chip by device kind (public figures), for MFU.
 # Aliases cover the PJRT device_kind strings actually observed in the wild
@@ -196,9 +202,53 @@ def _salvage_json(text: str):
     return None
 
 
+def _backend_reachable():
+    """(ok, detail): can a fresh process enumerate devices in bounded time?"""
+    probe = (
+        "import jax; ds = jax.devices(); "
+        "print(len(ds), ds[0].device_kind, jax.default_backend())"
+    )
+    last = None
+    for attempt in range(PROBE_ATTEMPTS):
+        if attempt:
+            time.sleep(BACKOFF_S)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out ({PROBE_TIMEOUT_S}s)"
+            continue
+        if proc.returncode == 0:
+            return True, proc.stdout.strip()
+        # Fast failure (broken install, plugin init error): report the real
+        # cause, not a fictitious timeout.
+        last = f"probe rc={proc.returncode}: {proc.stderr.strip()[-800:]}"
+    return False, f"{PROBE_ATTEMPTS} attempts; last: {last}"
+
+
 def main():
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))
+        return
+
+    ok, detail = _backend_reachable()
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": 0.0,
+                    "unit": UNIT,
+                    "vs_baseline": 0.0,
+                    "error": f"backend unreachable: {detail}",
+                    "backend": os.environ.get("JAX_PLATFORMS", "default"),
+                }
+            )
+        )
         return
 
     last_err = "unknown"

@@ -130,10 +130,7 @@ class Federation:
             )
         else:
             from fedtpu.data.device import make_sharded_data_round_step
-            from fedtpu.parallel.sharded import (
-                make_sharded_round_step,
-                shard_state,
-            )
+            from fedtpu.parallel.sharded import make_sharded_round_step
 
             self._round_step = make_sharded_round_step(
                 self.model, cfg, mesh, compressor
@@ -142,7 +139,7 @@ class Federation:
                 self.model, cfg, self._steps, mesh, compressor, shuffle=shuffle,
                 image_shape=img_shape,
             )
-            self.state = shard_state(self.state, mesh, cfg.mesh_axis)
+            # self.state was already mesh-placed by the property setter.
             self.weights = self._placed(self.weights, sharded=True)
         # Device-resident data (uploaded lazily on the first device-path
         # step, so explicit-batch callers never pay the HBM footprint):
@@ -238,6 +235,20 @@ class Federation:
     def state(self, s: FederatedState) -> None:
         # External assignment (e.g. checkpoint resume) invalidates the
         # host-side round counter; it re-syncs from the device on next use.
+        # On a mesh, host/numpy trees (a restored checkpoint) are placed with
+        # the engine's shardings so resume Just Works; trees that already
+        # hold non-addressable global arrays (multi-controller stepping
+        # output) are left untouched.
+        if self.mesh is not None:
+            leaves = jax.tree_util.tree_leaves(s)
+            already_global = any(
+                isinstance(l, jax.Array) and not l.is_fully_addressable
+                for l in leaves
+            )
+            if not already_global:
+                from fedtpu.parallel.sharded import shard_state
+
+                s = shard_state(s, self.mesh, self.cfg.mesh_axis)
         self._state = s
         self._round_host = None
 

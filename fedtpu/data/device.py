@@ -140,6 +140,121 @@ def make_data_round_step(
     return step
 
 
+def make_multi_round_step(
+    model,
+    cfg: RoundConfig,
+    steps: int,
+    num_rounds: int,
+    compressor=None,
+    shuffle: bool = True,
+    axis_name: Optional[str] = None,
+    stream: Optional[bool] = None,
+    image_shape: Optional[Tuple[int, ...]] = None,
+) -> Callable[..., Tuple[FederatedState, RoundMetrics]]:
+    """``num_rounds`` federated rounds as ONE XLA program (``lax.scan``).
+
+    The reference pays a full host round-trip per round — thread fan-out,
+    blocking RPCs, checkpoint files (``src/server.py:120-153``). The jitted
+    single-round step already collapses that to one dispatch per round, but
+    on a remote/tunneled device even dispatch+sync latency dominates small
+    rounds. Scanning the round body keeps the WHOLE multi-round run on
+    device: per-round batches are still gathered fresh inside each scan
+    iteration (``round_take_indices`` folds ``round_idx`` into the shuffle
+    key, so round r's batches are identical to the sequential path's), and
+    per-round metrics come back stacked ``[num_rounds, ...]``.
+
+    Signature matches :func:`make_data_round_step` except ``alive`` is
+    ``[num_rounds, clients]`` — one participation mask per round, so
+    heartbeat deaths / client subsampling still vary per round inside the
+    fused program. Returns ``(final_state, metrics_stacked)``.
+    """
+    body = make_data_round_step(
+        model, cfg, steps, compressor, shuffle=shuffle, axis_name=axis_name,
+        stream=stream, image_shape=image_shape,
+    )
+
+    def multi(
+        state: FederatedState,
+        images: jnp.ndarray,
+        labels: jnp.ndarray,
+        idx: jnp.ndarray,
+        mask: jnp.ndarray,
+        weights: jnp.ndarray,
+        alive: jnp.ndarray,
+        data_key: jax.Array,
+    ) -> Tuple[FederatedState, RoundMetrics]:
+        def scan_body(st, alive_r):
+            return body(st, images, labels, idx, mask, weights, alive_r,
+                        data_key)
+
+        return jax.lax.scan(scan_body, state, alive, length=num_rounds)
+
+    return multi
+
+
+def _shard_wrap(body, cfg: RoundConfig, mesh, alive_ndim: int, donate: bool):
+    """Common shard_map+jit wrapper for the data-round bodies.
+
+    Per-client state/assignment shard on the clients axis; the dataset is
+    replicated to every device (CIFAR-scale data fits HBM many times over,
+    and replication keeps the gather local — no cross-chip data motion);
+    FedAvg psums over ICI. ``alive_ndim`` is 1 for a single-round body
+    (``[clients]``) or 2 for the multi-round scan (``[rounds, clients]``,
+    client axis sharded).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fedtpu.parallel.sharded import state_specs
+
+    axis = cfg.mesh_axis
+    if cfg.fed.num_clients % mesh.devices.size:
+        raise ValueError(
+            f"num_clients={cfg.fed.num_clients} not divisible by mesh size "
+            f"{mesh.devices.size}"
+        )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            state_specs(axis),  # state
+            P(),                # images (replicated)
+            P(),                # labels (replicated)
+            P(axis),            # idx
+            P(axis),            # mask
+            P(axis),            # weights
+            P(axis) if alive_ndim == 1 else P(None, axis),  # alive
+            P(),                # data_key
+        ),
+        out_specs=(state_specs(axis), RoundMetrics(P(), P(), P(), P())),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_sharded_multi_round_step(
+    model,
+    cfg: RoundConfig,
+    steps: int,
+    num_rounds: int,
+    mesh,
+    compressor=None,
+    shuffle: bool = True,
+    donate: bool = True,
+    stream: Optional[bool] = None,
+    image_shape: Optional[Tuple[int, ...]] = None,
+):
+    """Mesh-parallel form of :func:`make_multi_round_step`: the scan runs
+    inside ``shard_map``, so a whole multi-round run is one program with one
+    psum per round over ICI and zero host involvement between rounds.
+    ``alive`` is ``[num_rounds, clients]``, sharded on its client axis."""
+    body = make_multi_round_step(
+        model, cfg, steps, num_rounds, compressor, shuffle=shuffle,
+        axis_name=cfg.mesh_axis, stream=stream, image_shape=image_shape,
+    )
+    return _shard_wrap(body, cfg, mesh, alive_ndim=2, donate=donate)
+
+
 def make_sharded_data_round_step(
     model,
     cfg: RoundConfig,
@@ -153,42 +268,12 @@ def make_sharded_data_round_step(
 ):
     """Mesh-parallel round step with the on-device gather inside each shard.
 
-    The clients axis of per-client state/assignment is sharded over ``mesh``;
-    the dataset is replicated to every device (CIFAR-scale data fits HBM many
-    times over, and replication keeps the gather local — no cross-chip
-    data motion); FedAvg psums over ICI. Call signature matches
-    :func:`make_data_round_step`; inputs must be placed with
-    :func:`shard_data_arrays` / :func:`fedtpu.parallel.shard_state`.
+    Call signature matches :func:`make_data_round_step`; inputs must be
+    placed with :func:`shard_data_arrays` / :func:`fedtpu.parallel.shard_state`.
+    Sharding layout: see :func:`_shard_wrap`.
     """
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    from fedtpu.parallel.sharded import state_specs
-
-    axis = cfg.mesh_axis
-    if cfg.fed.num_clients % mesh.devices.size:
-        raise ValueError(
-            f"num_clients={cfg.fed.num_clients} not divisible by mesh size "
-            f"{mesh.devices.size}"
-        )
     body = make_data_round_step(
-        model, cfg, steps, compressor, shuffle=shuffle, axis_name=axis,
+        model, cfg, steps, compressor, shuffle=shuffle, axis_name=cfg.mesh_axis,
         stream=stream, image_shape=image_shape,
     )
-    sharded = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(
-            state_specs(axis),  # state
-            P(),                # images (replicated)
-            P(),                # labels (replicated)
-            P(axis),            # idx
-            P(axis),            # mask
-            P(axis),            # weights
-            P(axis),            # alive
-            P(),                # data_key
-        ),
-        out_specs=(state_specs(axis), RoundMetrics(P(), P(), P(), P())),
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return _shard_wrap(body, cfg, mesh, alive_ndim=1, donate=donate)

@@ -106,6 +106,22 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         help="delta codec; default: topk when -c Y, none otherwise",
     )
     p.add_argument("--topk-fraction", default=0.01, type=float)
+    p.add_argument(
+        "--server-optimizer",
+        default="none",
+        choices=["none", "momentum", "adam"],
+        help="server-side optimizer over the aggregated delta (FedOpt "
+        "family): none = FedAvg (reference semantics), momentum = FedAvgM, "
+        "adam = FedAdam",
+    )
+    p.add_argument("--server-lr", default=1.0, type=float)
+    p.add_argument(
+        "--participation-fraction",
+        default=1.0,
+        type=float,
+        help="random fraction of live clients sampled each round "
+        "(1.0 = all, reference behavior)",
+    )
 
 
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
@@ -141,6 +157,11 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
             compression=compression,
             topk_fraction=getattr(args, "topk_fraction", 0.01),
+            server_optimizer=getattr(args, "server_optimizer", "none"),
+            server_lr=getattr(args, "server_lr", 1.0),
+            participation_fraction=getattr(
+                args, "participation_fraction", 1.0
+            ),
         ),
         steps_per_round=steps_per_round,
     )

@@ -93,6 +93,16 @@ class FedConfig:
     compression: str = "none"
     topk_fraction: float = 0.01
     error_feedback: bool = True
+    # Server-side optimizer applied to the aggregated delta (the FedOpt
+    # family, Reddi et al. 2021 — "adaptive federated optimization"). The
+    # reference applies the mean delta directly (src/server.py:170-179),
+    # which is server_optimizer="none" (== FedAvg). "momentum" = FedAvgM,
+    # "adam" = FedAdam; the mean client delta acts as the pseudo-gradient.
+    server_optimizer: str = "none"  # none | momentum | adam
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
+    server_beta2: float = 0.999
+    server_eps: float = 1e-8
 
 
 @dataclasses.dataclass(frozen=True)

@@ -42,6 +42,9 @@ class FederatedState(NamedTuple):
     - ``comp_state``: per-client compressor residuals (error feedback,
       :mod:`fedtpu.ops.compression`); the empty pytree ``()`` when
       compression or error feedback is off.
+    - ``server_opt_state``: server optimizer moments over the global model
+      (:mod:`fedtpu.core.server_opt`, the FedOpt family); ``()`` for plain
+      FedAvg.
     """
 
     params: Pytree
@@ -50,6 +53,7 @@ class FederatedState(NamedTuple):
     client_rng: jnp.ndarray
     round_idx: jnp.ndarray
     comp_state: Pytree = ()
+    server_opt_state: Pytree = ()
 
 
 class RoundMetrics(NamedTuple):
@@ -97,6 +101,8 @@ def init_state(
     opt_state = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy(), single
     )
+    from fedtpu.core import server_opt
+
     return FederatedState(
         params=params,
         batch_stats=batch_stats,
@@ -104,6 +110,7 @@ def init_state(
         client_rng=jax.random.split(client_rng, n),
         round_idx=jnp.zeros((), jnp.int32),
         comp_state=() if compressor is None else compressor.init(params, n),
+        server_opt_state=server_opt.init(cfg.fed, params),
     )
 
 
@@ -162,6 +169,9 @@ def make_round_step(
     batch, so nothing ``[clients, steps, batch, ...]``-sized is ever
     materialised — see :mod:`fedtpu.data.device`.
     """
+    from fedtpu.core import server_opt as server_opt_lib
+
+    server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
     local_update = make_local_update(
         model.apply, cfg, stream=stream, image_shape=image_shape
     )
@@ -245,7 +255,9 @@ def make_round_step(
             else:
                 comp_state = new_comp
         mean_delta, _ = _mean_over_clients(deltas, agg_w, axis_name)
-        new_params = trees.tree_add(state.params, mean_delta)
+        new_params, new_server_opt = server_opt_lib.apply(
+            server_opt, state.params, mean_delta, state.server_opt_state
+        )
 
         # BN running stats are averaged alongside weights, matching the
         # reference aggregator which averages the full state_dict including
@@ -279,6 +291,7 @@ def make_round_step(
             client_rng=state.client_rng,
             round_idx=state.round_idx + 1,
             comp_state=comp_state,
+            server_opt_state=new_server_opt,
         )
         return new_state, metrics
 

@@ -37,6 +37,7 @@ def state_specs(axis: str) -> FederatedState:
         client_rng=P(axis),
         round_idx=P(),
         comp_state=P(axis),
+        server_opt_state=P(),  # server moments act on the global model
     )
 
 
@@ -113,6 +114,9 @@ def shard_state(state: FederatedState, mesh: Mesh, axis: str) -> FederatedState:
         client_rng=put(state.client_rng, P(axis)),
         round_idx=put(state.round_idx, P()),
         comp_state=jax.tree.map(lambda x: put(x, P(axis)), state.comp_state),
+        server_opt_state=jax.tree.map(
+            lambda x: put(x, P()), state.server_opt_state
+        ),
     )
 
 

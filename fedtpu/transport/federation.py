@@ -295,6 +295,10 @@ class PrimaryServer:
         )
         self.params = variables["params"]
         self.batch_stats = variables.get("batch_stats", {})
+        from fedtpu.core import server_opt as server_opt_lib
+
+        self._server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
+        self._server_opt_state = server_opt_lib.init(cfg.fed, self.params)
         if initial_model is not None:
             self._install(initial_model)
 
@@ -320,28 +324,70 @@ class PrimaryServer:
         self._did_initial_sync = False
 
     # ----------------------------------------------------------- aggregation
-    def _aggregate_impl(self, global_tree, stacked_deltas, weights):
+    def _aggregate_impl(self, global_tree, stacked_deltas, weights, opt_state):
         """global + weighted mean of client deltas over the stacked axis —
         one jitted program, same math as the simulated engine's aggregator;
-        dead clients never enter the stack so no mask is needed here."""
+        dead clients never enter the stack so no mask is needed here. The
+        optional server optimizer (FedOpt family, fedtpu.core.server_opt)
+        consumes the mean params-delta; BN stats always take the plain mean,
+        mirroring the simulated round step."""
+        from fedtpu.core import server_opt as server_opt_lib
+
         total = jnp.maximum(jnp.sum(weights), 1e-9)
 
-        def leaf(g, d):
+        def mean(d):
             w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-            return g + jnp.sum(d * w, axis=0) / total.astype(d.dtype)
+            return jnp.sum(d * w, axis=0) / total.astype(d.dtype)
 
-        return jax.tree.map(leaf, global_tree, stacked_deltas)
+        deltas = jax.tree.map(mean, stacked_deltas)
+        new_params, new_opt = server_opt_lib.apply(
+            self._server_opt, global_tree["params"], deltas["params"], opt_state
+        )
+        new_stats = jax.tree.map(
+            lambda g, d: g + d, global_tree["batch_stats"], deltas["batch_stats"]
+        )
+        return {"params": new_params, "batch_stats": new_stats}, new_opt
 
     # ------------------------------------------------------------- transport
     def model_bytes(self) -> bytes:
+        """Client-broadcast payload: the global model only."""
         return wire.encode(
             {"params": self.params, "batch_stats": self.batch_stats},
             compress=self.compress,
         )
 
+    def replica_bytes(self) -> bytes:
+        """Backup-replication payload: the model plus (when a server
+        optimizer is configured) its moments, so a promotion or a recovering
+        primary resumes the FedOpt trajectory instead of applying stale/zero
+        moments to a model they were never computed against."""
+        tree = {"params": self.params, "batch_stats": self.batch_stats}
+        if self._server_opt is not None:
+            tree["server_opt"] = self._server_opt_state
+        return wire.encode(tree, compress=self.compress)
+
     def _install(self, data: bytes) -> None:
+        """Install a replica payload (or a plain model payload — e.g. one
+        replicated by a server generation with server_optimizer=none)."""
+        from fedtpu.core import server_opt as server_opt_lib
+
         params, stats = _model_template(self.model, self.cfg)
-        tree = wire.decode(data, {"params": params, "batch_stats": stats})
+        template = {"params": params, "batch_stats": stats}
+        tree = None
+        if self._server_opt is not None:
+            full = dict(
+                template,
+                server_opt=server_opt_lib.init(self.cfg.fed, params),
+            )
+            try:
+                tree = wire.decode(data, full)
+                self._server_opt_state = jax.tree.map(
+                    jnp.asarray, tree["server_opt"]
+                )
+            except ValueError:
+                tree = None  # model-only payload; keep current moments
+        if tree is None:
+            tree = wire.decode(data, template)
         self.params = jax.tree.map(jnp.asarray, tree["params"])
         self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
 
@@ -486,10 +532,11 @@ class PrimaryServer:
                 )
             else:
                 weights = jnp.ones((len(order),), jnp.float32)
-            new_global = self._aggregate(
+            new_global, self._server_opt_state = self._aggregate(
                 {"params": self.params, "batch_stats": self.batch_stats},
                 stacked,
                 weights,
+                self._server_opt_state,
             )
             self.params = new_global["params"]
             self.batch_stats = new_global["batch_stats"]
@@ -497,13 +544,15 @@ class PrimaryServer:
         payload = self.model_bytes()
         bytes_down = [0]  # only successful sends count
         # Backup first (parity: replication before client broadcast,
-        # src/server.py:141-153).
+        # src/server.py:141-153). The backup gets the replica payload —
+        # model + server-optimizer moments — not the client payload.
         if self.backup_stub is not None:
+            replica = self.replica_bytes()
             try:
                 self.backup_stub.SendModel(
-                    proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
+                    proto.SendModelRequest(model=replica), timeout=self.rpc_timeout
                 )
-                bytes_down[0] += len(payload)
+                bytes_down[0] += len(replica)
             except grpc.RpcError:
                 log.warning("backup unreachable during replication")
 
@@ -632,7 +681,7 @@ class BackupServer(TrainerServicer):
         self._stop_acting(wait=300.0)
         acting = self.acting
         if acting is not None and acting.history:
-            return proto.SendModelRequest(model=acting.model_bytes())
+            return proto.SendModelRequest(model=acting.replica_bytes())
         return proto.SendModelRequest(model=self.latest_model or b"")
 
     # -------------------------------------------------------------- failover
@@ -656,7 +705,7 @@ class BackupServer(TrainerServicer):
             # recovered primary) starts from its progress, not from the
             # pre-failover snapshot.
             if acting.history:
-                self.latest_model = acting.model_bytes()
+                self.latest_model = acting.replica_bytes()
 
         self._promote_thread = threading.Thread(target=run_acting, daemon=True)
         self._promote_thread.start()

@@ -1,0 +1,206 @@
+"""Server-side optimizers (fedtpu.core.server_opt — the FedOpt family).
+
+The reference applies the mean delta directly (``src/server.py:170-179``);
+that is server_optimizer="none". These tests pin: the reduction of
+momentum(lr=1, m=0) to exact FedAvg, that momentum/adam actually change the
+trajectory, state threading through the fused scan and the mesh path, and
+checkpoint roundtrip of the server moments.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core import Federation
+
+
+def _cfg(**fed_kw):
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic",
+            batch_size=4,
+            partition="round_robin",
+            num_examples=96,
+        ),
+        fed=FedConfig(num_clients=3, **fed_kw),
+        steps_per_round=2,
+    )
+
+
+def _leaves(t):
+    return jax.tree_util.tree_leaves(t)
+
+
+def test_momentum_lr1_m0_is_exactly_fedavg():
+    plain = Federation(_cfg(), seed=0)
+    degenerate = Federation(
+        _cfg(server_optimizer="momentum", server_lr=1.0, server_momentum=0.0),
+        seed=0,
+    )
+    for _ in range(3):
+        plain.step()
+        degenerate.step()
+    for a, b in zip(_leaves(plain.state.params), _leaves(degenerate.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+@pytest.mark.parametrize("name", ["momentum", "adam"])
+def test_server_opt_changes_trajectory_and_threads_state(name):
+    plain = Federation(_cfg(), seed=0)
+    fedopt = Federation(
+        _cfg(server_optimizer=name, server_lr=0.5), seed=0
+    )
+    assert _leaves(fedopt.state.server_opt_state), "server opt state is empty"
+    for _ in range(2):
+        plain.step()
+        fedopt.step()
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(_leaves(plain.state.params), _leaves(fedopt.state.params))
+    ]
+    assert max(diffs) > 1e-6, f"{name} produced the same params as FedAvg"
+    for leaf in _leaves(fedopt.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_server_opt_through_fused_scan():
+    seq = Federation(_cfg(server_optimizer="momentum", server_lr=0.5), seed=0)
+    fused = Federation(_cfg(server_optimizer="momentum", server_lr=0.5), seed=0)
+    for _ in range(3):
+        seq.step()
+    fused.run_on_device(3)
+    for a, b in zip(_leaves(seq.state.params), _leaves(fused.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    for a, b in zip(
+        _leaves(seq.state.server_opt_state), _leaves(fused.state.server_opt_state)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_server_opt_mesh_matches_single_program(eight_devices):
+    from fedtpu.parallel import client_mesh
+
+    cfg = dataclasses.replace(
+        _cfg(server_optimizer="adam", server_lr=0.1),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=8, server_optimizer="adam", server_lr=0.1),
+    )
+    single = Federation(cfg, seed=0)
+    meshed = Federation(cfg, seed=0, mesh=client_mesh(8))
+    for _ in range(2):
+        single.step()
+        meshed.step()
+    for a, b in zip(_leaves(single.state.params), _leaves(meshed.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_server_opt_state_checkpoint_roundtrip(tmp_path):
+    from fedtpu.checkpoint import Checkpointer
+
+    fed = Federation(_cfg(server_optimizer="adam"), seed=0)
+    fed.step()
+    ckpt = Checkpointer(str(tmp_path), backend="wire")
+    ckpt.save(1, fed.state)
+
+    fresh = Federation(_cfg(server_optimizer="adam"), seed=0)
+    rnd, restored = ckpt.restore_latest(like=fresh.state)
+    assert rnd == 1
+    for a, b in zip(
+        _leaves(fed.state.server_opt_state), _leaves(restored.server_opt_state)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_unknown_server_optimizer_raises():
+    from fedtpu.core import server_opt
+
+    with pytest.raises(ValueError, match="unknown server_optimizer"):
+        server_opt.make_server_optimizer(
+            FedConfig(server_optimizer="nesterov")
+        )
+
+
+def test_replica_payload_carries_server_moments():
+    """Failover must not desync FedOpt moments from the model: the backup
+    replication payload includes server_opt_state, and _install restores it.
+    A model-only payload (from a server_optimizer=none generation) still
+    installs, keeping the receiver's current moments."""
+    import jax.numpy as jnp
+
+    from fedtpu.transport.federation import PrimaryServer
+
+    cfg = _cfg(server_optimizer="adam", server_lr=0.5)
+    src = PrimaryServer(cfg, clients=[], seed=0)
+    # Advance the source's moments so they are distinguishable from init.
+    deltas = jax.tree.map(
+        lambda p: jnp.stack([jnp.ones_like(p) * 0.01]),
+        {"params": src.params, "batch_stats": src.batch_stats},
+    )
+    g = {"params": src.params, "batch_stats": src.batch_stats}
+    out, src._server_opt_state = src._aggregate(
+        g, deltas, jnp.asarray([1.0]), src._server_opt_state
+    )
+    src.params = out["params"]
+
+    dst = PrimaryServer(cfg, clients=[], seed=1)
+    dst._install(src.replica_bytes())
+    for a, b in zip(
+        _leaves(src._server_opt_state), _leaves(dst._server_opt_state)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    for a, b in zip(_leaves(src.params), _leaves(dst.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # Model-only payload from a "none" generation: installs the model,
+    # leaves the receiver's moments untouched.
+    plain = PrimaryServer(_cfg(), clients=[], seed=2)
+    before = [np.asarray(x).copy() for x in _leaves(dst._server_opt_state)]
+    dst._install(plain.model_bytes())
+    for a, b in zip(before, _leaves(dst._server_opt_state)):
+        np.testing.assert_allclose(a, np.asarray(b))
+
+
+def test_distributed_edge_applies_server_opt():
+    """The gRPC PrimaryServer's jitted aggregate honors the server optimizer:
+    momentum(lr=1, m=0) == plain mean; adam != plain mean."""
+    import jax.numpy as jnp
+
+    from fedtpu.transport.federation import PrimaryServer
+
+    def mk(fed_kw):
+        cfg = _cfg(**fed_kw)
+        return PrimaryServer(cfg, clients=[], seed=0)
+
+    plain = mk({})
+    degen = mk(dict(server_optimizer="momentum", server_lr=1.0,
+                    server_momentum=0.0))
+    adam = mk(dict(server_optimizer="adam", server_lr=0.5))
+
+    deltas = jax.tree.map(
+        lambda p: jnp.stack([jnp.ones_like(p) * 0.01, jnp.ones_like(p) * 0.03]),
+        {"params": plain.params, "batch_stats": plain.batch_stats},
+    )
+    w = jnp.asarray([1.0, 1.0])
+
+    def agg(srv):
+        g = {"params": srv.params, "batch_stats": srv.batch_stats}
+        out, _ = srv._aggregate(g, deltas, w, srv._server_opt_state)
+        return out["params"]
+
+    p_plain, p_degen, p_adam = agg(plain), agg(degen), agg(adam)
+    for a, b in zip(_leaves(p_plain), _leaves(p_degen)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(_leaves(p_plain), _leaves(p_adam))
+    ]
+    assert max(diffs) > 1e-6

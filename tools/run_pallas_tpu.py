@@ -25,14 +25,19 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 ROWS = 64  # clients
 COLS = 3_217_152 // 64 * 64  # ~MobileNet param count, lane-friendly
-TRIALS = 20
+TRIALS = 10
+
+
+def _log(msg):
+    print(f"[run_pallas_tpu] {msg}", file=sys.stderr, flush=True)
 
 
 def _median_time(fn, *args):
     out = fn(*args)
     jax_block(out)
+    _log("warmup done")
     ts = []
-    for _ in range(TRIALS):
+    for i in range(TRIALS):
         t0 = time.perf_counter()
         out = fn(*args)
         jax_block(out)
@@ -54,7 +59,9 @@ def main():
 
     from fedtpu.ops import pallas_kernels as pk
 
+    _log("enumerating devices")
     dev = jax.devices()[0]
+    _log(f"device: {dev.device_kind}")
     result = {
         "device_kind": dev.device_kind,
         "backend": jax.default_backend(),
@@ -80,10 +87,12 @@ def main():
 
     y, thresh, scale = _make_inputs(jax.random.PRNGKey(0))
     jax_block((y, thresh, scale))
+    _log("inputs generated on device")
 
     nbytes = y.size * 4
 
     # --- threshold_with_feedback: reads y (+ thresh), writes out + new_e.
+    _log("threshold kernel: compiling mosaic")
     t_mosaic, (out_m, e_m) = _median_time(
         lambda a, b: pk.threshold_with_feedback(a, b, interpret=False), y, thresh
     )
@@ -107,6 +116,7 @@ def main():
     }
 
     # --- quantdequant_int8: reads x, writes out.
+    _log("quant kernel: compiling mosaic")
     t_mosaic, q_m = _median_time(
         lambda a, b: pk.quantdequant_int8(a, b, interpret=False), y, scale
     )

@@ -70,7 +70,36 @@ def restore(
         restored = ckptr.restore(orbax_p, host_like)
         return jax.tree.map(lambda l, r: np.asarray(r, l.dtype), host_like, restored)
     with open(wire_p, "rb") as fh:
-        return wire.decode(fh.read(), like)
+        data = fh.read()
+    try:
+        return wire.decode(data, like)
+    except ValueError:
+        legacy = _legacy_decode(data, like)
+        if legacy is not None:
+            return legacy
+        raise
+
+
+# State fields added after the first release of the wire format. Checkpoints
+# written before a field existed lack its key, and flax's from_bytes raises on
+# any key mismatch — so a failed decode retries with these dropped from the
+# template and refills them from ``like`` (i.e. their freshly-initialised
+# values, which is exactly right for a state the old run never had).
+_NEW_STATE_FIELDS = ("server_opt_state",)
+
+
+def _legacy_decode(data: bytes, like: Pytree) -> Optional[Pytree]:
+    if not hasattr(like, "_asdict"):
+        return None
+    d = dict(like._asdict())
+    dropped = {k: d.pop(k) for k in _NEW_STATE_FIELDS if k in d}
+    if not dropped:
+        return None
+    try:
+        tree = wire.decode(data, d)
+    except ValueError:
+        return None
+    return type(like)(**tree, **dropped)
 
 
 def _scan_rounds(directory: str) -> List[int]:

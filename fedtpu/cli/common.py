@@ -107,6 +107,15 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--topk-fraction", default=0.01, type=float)
     p.add_argument(
+        "--aggregator",
+        default="mean",
+        choices=["mean", "median", "trimmed_mean"],
+        help="delta combine rule: mean = (weighted) FedAvg (reference "
+        "semantics); median / trimmed_mean = coordinate-wise "
+        "Byzantine-robust aggregation",
+    )
+    p.add_argument("--trim-fraction", default=0.1, type=float)
+    p.add_argument(
         "--server-optimizer",
         default="none",
         choices=["none", "momentum", "adam"],
@@ -157,6 +166,8 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
             compression=compression,
             topk_fraction=getattr(args, "topk_fraction", 0.01),
+            aggregator=getattr(args, "aggregator", "mean"),
+            trim_fraction=getattr(args, "trim_fraction", 0.1),
             server_optimizer=getattr(args, "server_optimizer", "none"),
             server_lr=getattr(args, "server_lr", 1.0),
             participation_fraction=getattr(

@@ -102,13 +102,16 @@ def main(argv=None) -> int:
         while r < cfg.fed.num_rounds:
             block = min(max(1, args.fused), cfg.fed.num_rounds - r)
             if block > 1:
+                import numpy as np
+
                 stacked = fed.run_on_device(block)
+                # Three bulk transfers, not 3*block scalar fetches — per-round
+                # float() would re-add the host round-trips fusion removes.
+                losses = np.asarray(stacked.loss)
+                accs = np.asarray(stacked.accuracy)
+                actives = np.asarray(stacked.num_active)
                 per_round = [
-                    (
-                        float(stacked.loss[i]),
-                        float(stacked.accuracy[i]),
-                        float(stacked.num_active[i]),
-                    )
+                    (float(losses[i]), float(accs[i]), float(actives[i]))
                     for i in range(block)
                 ]
             else:

@@ -103,6 +103,12 @@ class FedConfig:
     server_momentum: float = 0.9
     server_beta2: float = 0.999
     server_eps: float = 1e-8
+    # How client deltas combine. "mean" is the reference's (weighted) FedAvg;
+    # "median" / "trimmed_mean" are coordinate-wise Byzantine-robust
+    # aggregators (Yin et al. 2018) — they ignore example-count weights by
+    # construction and tolerate up to ~trim_fraction of adversarial clients.
+    aggregator: str = "mean"  # mean | median | trimmed_mean
+    trim_fraction: float = 0.1
 
 
 @dataclasses.dataclass(frozen=True)

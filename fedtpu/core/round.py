@@ -114,6 +114,66 @@ def init_state(
     )
 
 
+def _robust_over_clients(
+    stacked: Pytree,
+    alive_w: jnp.ndarray,
+    axis_name,
+    aggregator: str,
+    trim: float,
+):
+    """Coordinate-wise Byzantine-robust combine over the clients axis.
+
+    ``median``: per-coordinate median of live clients' deltas.
+    ``trimmed_mean``: mask coordinates outside the [trim, 1-trim] quantile
+    band, then average the survivors (Yin et al. 2018, coordinate-wise).
+    Dead/unsampled clients (``alive_w == 0``) are excluded via NaN-masking.
+    Example-count weights are deliberately ignored: a robust aggregator that
+    weighted by client-reported counts would hand adversaries their
+    influence back.
+
+    Under ``shard_map`` the statistic is global per coordinate, so the local
+    client slices are first ``all_gather``-ed along the mesh axis — the
+    collective rides ICI; the host never participates. This costs one full
+    per-client delta tree per device; fine at CNN scale, and the price of a
+    true global median (a mean can psum partial sums, a median cannot).
+    """
+    total = jnp.sum(alive_w)
+    if axis_name is not None:
+        total = jax.lax.psum(total, axis_name)
+    alive_any = total > 0
+
+    def leaf(x):
+        if axis_name is not None:
+            x = jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+            w = jax.lax.all_gather(alive_w, axis_name, axis=0, tiled=True)
+        else:
+            w = alive_w
+        mask = (w > 0).reshape((-1,) + (1,) * (x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        masked = jnp.where(mask, xf, jnp.nan)
+        if aggregator == "median":
+            out = jnp.nanmedian(masked, axis=0)
+        else:  # trimmed_mean
+            # Band bounds snap to actual data points (method lower/higher):
+            # an interpolated bound can exclude EVERY value at small client
+            # counts (verified at n=2), silently zeroing the update.
+            lo = jnp.nanquantile(
+                masked, trim, axis=0, keepdims=True, method="lower"
+            )
+            hi = jnp.nanquantile(
+                masked, 1.0 - trim, axis=0, keepdims=True, method="higher"
+            )
+            band = jnp.where(
+                (masked >= lo) & (masked <= hi), masked, jnp.nan
+            )
+            out = jnp.nanmean(band, axis=0)
+        # All-dead round (or a coordinate with no survivors): no update.
+        out = jnp.nan_to_num(out, nan=0.0)
+        return jnp.where(alive_any, out, 0.0).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
 def _mean_over_clients(stacked: Pytree, weights: jnp.ndarray, axis_name):
     """Masked weighted mean over the clients axis.
 
@@ -171,6 +231,26 @@ def make_round_step(
     """
     from fedtpu.core import server_opt as server_opt_lib
 
+    if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean"):
+        raise ValueError(
+            f"unknown aggregator {cfg.fed.aggregator!r}; "
+            "have mean | median | trimmed_mean"
+        )
+    if cfg.fed.aggregator != "mean":
+        if compressor is not None:
+            # Top-k deltas are zero outside each client's own top coordinates,
+            # so a coordinate-wise median over them is ~0 everywhere — the
+            # model would silently stop moving while residuals cycle.
+            raise ValueError(
+                f"aggregator={cfg.fed.aggregator!r} cannot compose with "
+                "delta compression: sparse deltas zero out coordinate-wise "
+                "robust statistics. Use compression='none'."
+            )
+        if not 0.0 <= cfg.fed.trim_fraction < 0.5:
+            raise ValueError(
+                f"trim_fraction must be in [0, 0.5), got "
+                f"{cfg.fed.trim_fraction}"
+            )
     server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
     local_update = make_local_update(
         model.apply, cfg, stream=stream, image_shape=image_shape
@@ -254,20 +334,25 @@ def make_round_step(
                 )
             else:
                 comp_state = new_comp
-        mean_delta, _ = _mean_over_clients(deltas, agg_w, axis_name)
+        if cfg.fed.aggregator == "mean":
+            combine = lambda t: _mean_over_clients(t, agg_w, axis_name)[0]
+        else:  # median | trimmed_mean — validated at build time
+            combine = lambda t: _robust_over_clients(
+                t, agg_w, axis_name, cfg.fed.aggregator, cfg.fed.trim_fraction
+            )
+        mean_delta = combine(deltas)
         new_params, new_server_opt = server_opt_lib.apply(
             server_opt, state.params, mean_delta, state.server_opt_state
         )
 
-        # BN running stats are averaged alongside weights, matching the
-        # reference aggregator which averages the full state_dict including
+        # BN running stats combine with the same aggregator, matching the
+        # reference which averages the full state_dict including
         # running_mean/var (src/server.py:163-171). Aggregated as deltas so an
         # all-dead round leaves them untouched too.
         stats_delta = jax.tree.map(
             lambda c, g: c - g[None], out.batch_stats, state.batch_stats
         )
-        mean_stats_delta, _ = _mean_over_clients(stats_delta, agg_w, axis_name)
-        new_stats = trees.tree_add(state.batch_stats, mean_stats_delta)
+        new_stats = trees.tree_add(state.batch_stats, combine(stats_delta))
 
         alive_f = batch.alive.astype(jnp.float32)
         loss_sum = jnp.sum(out.loss * alive_f)

@@ -60,7 +60,16 @@ def dryrun_multichip(n_devices: int, model: str = "smallcnn") -> None:
     )
     jax.block_until_ready(new_state)
     assert int(metrics.num_active) == n
+
+    # Also compile+run the fused multi-round scan over the same mesh (the
+    # headline-bench path): 2 rounds as one XLA program, shard_map inside.
+    from fedtpu.core import Federation
+
+    fed = Federation(cfg, seed=0, mesh=mesh)
+    stacked = fed.run_on_device(2)
+    assert stacked.loss.shape == (2,)
+    assert int(fed.state.round_idx) == 2
     print(
         f"dryrun_multichip ok: {n_devices} devices, {n} clients, "
-        f"loss={float(metrics.loss):.4f}"
+        f"loss={float(metrics.loss):.4f}, fused2_loss={float(stacked.loss[-1]):.4f}"
     )

@@ -297,6 +297,23 @@ class PrimaryServer:
         self.batch_stats = variables.get("batch_stats", {})
         from fedtpu.core import server_opt as server_opt_lib
 
+        if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean"):
+            raise ValueError(
+                f"unknown aggregator {cfg.fed.aggregator!r}; "
+                "have mean | median | trimmed_mean"
+            )
+        if cfg.fed.aggregator != "mean":
+            if cfg.fed.compression != "none":
+                raise ValueError(
+                    f"aggregator={cfg.fed.aggregator!r} cannot compose with "
+                    "delta compression: sparse deltas zero out coordinate-"
+                    "wise robust statistics. Use compression='none'."
+                )
+            if not 0.0 <= cfg.fed.trim_fraction < 0.5:
+                raise ValueError(
+                    f"trim_fraction must be in [0, 0.5), got "
+                    f"{cfg.fed.trim_fraction}"
+                )
         self._server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
         self._server_opt_state = server_opt_lib.init(cfg.fed, self.params)
         if initial_model is not None:
@@ -325,21 +342,44 @@ class PrimaryServer:
 
     # ----------------------------------------------------------- aggregation
     def _aggregate_impl(self, global_tree, stacked_deltas, weights, opt_state):
-        """global + weighted mean of client deltas over the stacked axis —
-        one jitted program, same math as the simulated engine's aggregator;
-        dead clients never enter the stack so no mask is needed here. The
-        optional server optimizer (FedOpt family, fedtpu.core.server_opt)
-        consumes the mean params-delta; BN stats always take the plain mean,
+        """global + combined client deltas over the stacked axis — one jitted
+        program, same math as the simulated engine's aggregator; dead clients
+        never enter the stack so no mask is needed here. ``cfg.fed.aggregator``
+        selects the combine (weighted mean, or coordinate-wise median /
+        trimmed mean — robust combiners ignore the example-count weights).
+        The optional server optimizer (FedOpt family, fedtpu.core.server_opt)
+        consumes the combined params-delta; BN stats combine the same way,
         mirroring the simulated round step."""
         from fedtpu.core import server_opt as server_opt_lib
 
+        fed = self.cfg.fed
         total = jnp.maximum(jnp.sum(weights), 1e-9)
 
         def mean(d):
             w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
             return jnp.sum(d * w, axis=0) / total.astype(d.dtype)
 
-        deltas = jax.tree.map(mean, stacked_deltas)
+        def robust(d):
+            xf = d.astype(jnp.float32)
+            if fed.aggregator == "median":
+                out = jnp.median(xf, axis=0)
+            else:  # trimmed_mean; data-point bounds so the band is never empty
+                lo = jnp.quantile(
+                    xf, fed.trim_fraction, axis=0, keepdims=True,
+                    method="lower",
+                )
+                hi = jnp.quantile(
+                    xf, 1.0 - fed.trim_fraction, axis=0, keepdims=True,
+                    method="higher",
+                )
+                band = (xf >= lo) & (xf <= hi)
+                out = jnp.sum(jnp.where(band, xf, 0.0), axis=0) / jnp.maximum(
+                    jnp.sum(band, axis=0), 1
+                )
+            return out.astype(d.dtype)
+
+        combine = mean if fed.aggregator == "mean" else robust
+        deltas = jax.tree.map(combine, stacked_deltas)
         new_params, new_opt = server_opt_lib.apply(
             self._server_opt, global_tree["params"], deltas["params"], opt_state
         )
@@ -446,6 +486,18 @@ class PrimaryServer:
         if not self._did_initial_sync:
             self.sync_clients()
         active = self.registry.active_clients()
+        # Random client subsampling (engine parity: _alive_for_round; the
+        # reference always uses every live client). Sampled-out clients skip
+        # this round's StartTrain but still receive the broadcast.
+        frac = cfg.fed.participation_fraction
+        if frac < 1.0 and active:
+            rng = np.random.default_rng(
+                cfg.data.seed * 7919 + len(self.history)
+            )
+            k = max(1, int(round(frac * len(active))))
+            active = sorted(
+                rng.choice(np.asarray(active), size=k, replace=False).tolist()
+            )
         world = len(self.registry.clients)
         # Host copies of the global model are only needed for dense replies /
         # sparse templates; build them lazily (in topk steady state the full

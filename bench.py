@@ -141,13 +141,21 @@ def _measure():
         args = (fed.state, d_images, d_labels, d_idx, d_mask, fed.weights,
                 alive, fed._data_key)
         step = multi.lower(*args).compile()
+        # FLOPs/round from the SINGLE-round program: XLA cost analysis counts
+        # a lax.scan body ONCE regardless of trip count (measured: the fused
+        # 10-round program reports the same flops as one round), so dividing
+        # the fused program's number by TIMED_ROUNDS — or trusting it to
+        # already be multiplied — would silently mis-scale MFU if that
+        # convention ever changes. The extra AOT compile is never executed.
         try:
-            analysis = step.cost_analysis()
+            single = fed._data_step.lower(
+                fed.state, d_images, d_labels, d_idx, d_mask, fed.weights,
+                jnp.ones((NUM_CLIENTS,), bool), fed._data_key,
+            ).compile()
+            analysis = single.cost_analysis()
             if isinstance(analysis, (list, tuple)):
                 analysis = analysis[0] if analysis else {}
-            flops_per_round = (
-                float(analysis.get("flops", 0.0)) / TIMED_ROUNDS
-            ) or None
+            flops_per_round = float(analysis.get("flops", 0.0)) or None
         except Exception:
             pass
         carry = {"state": fed.state}

@@ -118,10 +118,10 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--server-optimizer",
         default="none",
-        choices=["none", "momentum", "adam"],
+        choices=["none", "momentum", "adam", "yogi"],
         help="server-side optimizer over the aggregated delta (FedOpt "
         "family): none = FedAvg (reference semantics), momentum = FedAvgM, "
-        "adam = FedAdam",
+        "adam = FedAdam, yogi = FedYogi",
     )
     p.add_argument("--server-lr", default=1.0, type=float)
     p.add_argument(

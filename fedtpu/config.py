@@ -97,8 +97,9 @@ class FedConfig:
     # family, Reddi et al. 2021 — "adaptive federated optimization"). The
     # reference applies the mean delta directly (src/server.py:170-179),
     # which is server_optimizer="none" (== FedAvg). "momentum" = FedAvgM,
-    # "adam" = FedAdam; the mean client delta acts as the pseudo-gradient.
-    server_optimizer: str = "none"  # none | momentum | adam
+    # "adam" = FedAdam, "yogi" = FedYogi; the mean client delta acts as the
+    # pseudo-gradient.
+    server_optimizer: str = "none"  # none | momentum | adam | yogi
     server_lr: float = 1.0
     server_momentum: float = 0.9
     server_beta2: float = 0.999

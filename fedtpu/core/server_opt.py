@@ -35,9 +35,14 @@ def make_server_optimizer(fed: FedConfig) -> Optional[optax.GradientTransformati
             fed.server_lr, b1=fed.server_momentum, b2=fed.server_beta2,
             eps=fed.server_eps,
         )
+    if fed.server_optimizer == "yogi":
+        return optax.yogi(
+            fed.server_lr, b1=fed.server_momentum, b2=fed.server_beta2,
+            eps=fed.server_eps,
+        )
     raise ValueError(
         f"unknown server_optimizer {fed.server_optimizer!r}; "
-        "have none | momentum | adam"
+        "have none | momentum | adam | yogi"
     )
 
 

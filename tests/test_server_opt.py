@@ -50,7 +50,7 @@ def test_momentum_lr1_m0_is_exactly_fedavg():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
 
 
-@pytest.mark.parametrize("name", ["momentum", "adam"])
+@pytest.mark.parametrize("name", ["momentum", "adam", "yogi"])
 def test_server_opt_changes_trajectory_and_threads_state(name):
     plain = Federation(_cfg(), seed=0)
     fedopt = Federation(

@@ -168,7 +168,15 @@ def main():
                    "bench_reference.py mirrors for the BASELINE.md table")
     p.add_argument("--only", default=None,
                    help="substring filter on config names")
+    from fedtpu.cli.common import add_platform_flag, apply_platform_flag
+
+    add_platform_flag(p)
     args = p.parse_args()
+    # Quick/cpu-scale modes are CPU workloads by definition; pin the platform
+    # so a wedged remote TPU backend can't hang them at jax.devices().
+    if args.platform is None and (args.quick or args.cpu_scale):
+        args.platform = "cpu"
+    apply_platform_flag(args)
     for name, cfg in configs(args.quick, cpu_scale=args.cpu_scale):
         if args.only and args.only not in name:
             continue

@@ -125,6 +125,20 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--server-lr", default=1.0, type=float)
     p.add_argument(
+        "--unweighted",
+        action="store_true",
+        help="uniform averaging over active clients instead of "
+        "example-count weighting (required for DP)",
+    )
+    p.add_argument(
+        "--dp-clip-norm",
+        default=0.0,
+        type=float,
+        help="DP-FedAvg: clip each client delta to this L2 norm (0 = off; "
+        "requires --unweighted, no compression, and a BatchNorm-free model)",
+    )
+    p.add_argument("--dp-noise-multiplier", default=0.0, type=float)
+    p.add_argument(
         "--participation-fraction",
         default=1.0,
         type=float,
@@ -170,6 +184,9 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             trim_fraction=getattr(args, "trim_fraction", 0.1),
             server_optimizer=getattr(args, "server_optimizer", "none"),
             server_lr=getattr(args, "server_lr", 1.0),
+            dp_clip_norm=getattr(args, "dp_clip_norm", 0.0),
+            dp_noise_multiplier=getattr(args, "dp_noise_multiplier", 0.0),
+            weighted=not getattr(args, "unweighted", False),
             participation_fraction=getattr(
                 args, "participation_fraction", 1.0
             ),

@@ -110,6 +110,14 @@ class FedConfig:
     # construction and tolerate up to ~trim_fraction of adversarial clients.
     aggregator: str = "mean"  # mean | median | trimmed_mean
     trim_fraction: float = 0.1
+    # Differential privacy (DP-FedAvg, McMahan et al. 2018): clip each
+    # client's delta to L2 norm dp_clip_norm (0 = off), then add Gaussian
+    # noise with std = dp_clip_norm * dp_noise_multiplier / n_participants
+    # to the aggregated delta. Requires uniform weighting (weighted=False)
+    # and compression='none' — both enforced — so the per-client
+    # sensitivity bound clip/n actually holds.
+    dp_clip_norm: float = 0.0
+    dp_noise_multiplier: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -95,6 +95,13 @@ def init_state(
     variables = model.init(init_rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
+    if cfg.fed.dp_clip_norm > 0 and jax.tree_util.tree_leaves(batch_stats):
+        raise ValueError(
+            "DP requires a BatchNorm-free model: batch statistics are "
+            "unbounded functions of client data and are released unclipped "
+            "and unnoised, voiding the sensitivity bound. Pick a model "
+            "without batch_stats (e.g. mlp)."
+        )
     n = cfg.fed.num_clients
     # Per-client momentum buffers, stacked along a new leading axis.
     single = optim.init(params)
@@ -174,6 +181,45 @@ def _robust_over_clients(
     return jax.tree.map(leaf, stacked)
 
 
+def _dp_clip(stacked: Pytree, clip_norm: float) -> Pytree:
+    """Scale each client's delta so its GLOBAL L2 norm (across all leaves)
+    is at most ``clip_norm`` (DP-FedAvg per-client sensitivity bound). Each
+    client lives wholly on one shard, so no collective is needed."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    sq = sum(
+        jnp.sum(
+            jnp.square(x.astype(jnp.float32)),
+            axis=tuple(range(1, x.ndim)),
+        )
+        for x in leaves
+    )
+    norm = jnp.sqrt(jnp.maximum(sq, 1e-24))  # [clients]
+    scale = jnp.minimum(1.0, clip_norm / norm)
+    return jax.tree.map(
+        lambda x: (
+            x.astype(jnp.float32)
+            * scale.reshape((-1,) + (1,) * (x.ndim - 1))
+        ).astype(x.dtype),
+        stacked,
+    )
+
+
+def _dp_noise(
+    tree: Pytree, std: jnp.ndarray, round_idx: jnp.ndarray, seed: int
+) -> Pytree:
+    """Add seeded Gaussian noise to the aggregated delta. The key depends
+    only on (static seed, round) so it is identical on every mesh shard —
+    the aggregated delta is replicated and must stay so."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(base, len(leaves))
+    noised = [
+        x + (jax.random.normal(k, x.shape, jnp.float32) * std).astype(x.dtype)
+        for x, k in zip(leaves, keys)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
 def _mean_over_clients(stacked: Pytree, weights: jnp.ndarray, axis_name):
     """Masked weighted mean over the clients axis.
 
@@ -250,6 +296,25 @@ def make_round_step(
             raise ValueError(
                 f"trim_fraction must be in [0, 0.5), got "
                 f"{cfg.fed.trim_fraction}"
+            )
+    if cfg.fed.dp_clip_norm > 0:
+        if compressor is not None:
+            raise ValueError(
+                "DP clipping cannot compose with delta compression: error "
+                "feedback re-injects unclipped residual, voiding the "
+                "sensitivity bound. Use compression='none'."
+            )
+        if cfg.fed.weighted:
+            raise ValueError(
+                "DP requires uniform weighting (FedConfig(weighted=False)): "
+                "example-count weights change per-client sensitivity."
+            )
+        if cfg.fed.aggregator != "mean":
+            raise ValueError(
+                "DP noise std clip*sigma/n assumes the mean aggregator; "
+                f"aggregator={cfg.fed.aggregator!r} has per-client "
+                "sensitivity up to ~clip, so the accounting would be "
+                "silently invalid. Use aggregator='mean'."
             )
     server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
     local_update = make_local_update(
@@ -340,7 +405,22 @@ def make_round_step(
             combine = lambda t: _robust_over_clients(
                 t, agg_w, axis_name, cfg.fed.aggregator, cfg.fed.trim_fraction
             )
+        if cfg.fed.dp_clip_norm > 0:
+            deltas = _dp_clip(deltas, cfg.fed.dp_clip_norm)
         mean_delta = combine(deltas)
+        if cfg.fed.dp_clip_norm > 0 and cfg.fed.dp_noise_multiplier > 0:
+            n_participants = jnp.sum((agg_w > 0).astype(jnp.float32))
+            if axis_name is not None:
+                n_participants = jax.lax.psum(n_participants, axis_name)
+            std = (
+                cfg.fed.dp_clip_norm
+                * cfg.fed.dp_noise_multiplier
+                / jnp.maximum(n_participants, 1.0)
+            )
+            mean_delta = _dp_noise(
+                mean_delta, std, state.round_idx,
+                seed=cfg.data.seed ^ 0x5F5E5F,
+            )
         new_params, new_server_opt = server_opt_lib.apply(
             server_opt, state.params, mean_delta, state.server_opt_state
         )

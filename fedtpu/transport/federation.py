@@ -314,6 +314,28 @@ class PrimaryServer:
                     f"trim_fraction must be in [0, 0.5), got "
                     f"{cfg.fed.trim_fraction}"
                 )
+        if cfg.fed.dp_clip_norm > 0:
+            # Same soundness guards as the simulated engine
+            # (fedtpu.core.round.make_round_step / init_state).
+            if cfg.fed.compression != "none":
+                raise ValueError(
+                    "DP clipping cannot compose with delta compression. "
+                    "Use compression='none'."
+                )
+            if cfg.fed.weighted:
+                raise ValueError(
+                    "DP requires uniform weighting (FedConfig(weighted=False))."
+                )
+            if cfg.fed.aggregator != "mean":
+                raise ValueError(
+                    "DP noise accounting assumes aggregator='mean'."
+                )
+            if jax.tree_util.tree_leaves(self.batch_stats):
+                raise ValueError(
+                    "DP requires a BatchNorm-free model: batch statistics "
+                    "are released unclipped. Pick a model without "
+                    "batch_stats (e.g. mlp)."
+                )
         self._server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
         self._server_opt_state = server_opt_lib.init(cfg.fed, self.params)
         if initial_model is not None:
@@ -341,16 +363,20 @@ class PrimaryServer:
         self._did_initial_sync = False
 
     # ----------------------------------------------------------- aggregation
-    def _aggregate_impl(self, global_tree, stacked_deltas, weights, opt_state):
+    def _aggregate_impl(
+        self, global_tree, stacked_deltas, weights, opt_state, round_idx
+    ):
         """global + combined client deltas over the stacked axis — one jitted
         program, same math as the simulated engine's aggregator; dead clients
         never enter the stack so no mask is needed here. ``cfg.fed.aggregator``
         selects the combine (weighted mean, or coordinate-wise median /
         trimmed mean — robust combiners ignore the example-count weights).
-        The optional server optimizer (FedOpt family, fedtpu.core.server_opt)
-        consumes the combined params-delta; BN stats combine the same way,
-        mirroring the simulated round step."""
+        DP (clip per client, seeded noise on the combined delta) mirrors the
+        engine's round step. The optional server optimizer (FedOpt family,
+        fedtpu.core.server_opt) consumes the combined params-delta; BN stats
+        combine the same way, mirroring the simulated round step."""
         from fedtpu.core import server_opt as server_opt_lib
+        from fedtpu.core.round import _dp_clip, _dp_noise
 
         fed = self.cfg.fed
         total = jnp.maximum(jnp.sum(weights), 1e-9)
@@ -379,7 +405,22 @@ class PrimaryServer:
             return out.astype(d.dtype)
 
         combine = mean if fed.aggregator == "mean" else robust
+        if fed.dp_clip_norm > 0:
+            stacked_deltas = dict(
+                stacked_deltas,
+                params=_dp_clip(stacked_deltas["params"], fed.dp_clip_norm),
+            )
         deltas = jax.tree.map(combine, stacked_deltas)
+        if fed.dp_clip_norm > 0 and fed.dp_noise_multiplier > 0:
+            n = jnp.asarray(weights.shape[0], jnp.float32)
+            std = fed.dp_clip_norm * fed.dp_noise_multiplier / jnp.maximum(n, 1.0)
+            deltas = dict(
+                deltas,
+                params=_dp_noise(
+                    deltas["params"], std, round_idx,
+                    seed=self.cfg.data.seed ^ 0x5F5E5F,
+                ),
+            )
         new_params, new_opt = server_opt_lib.apply(
             self._server_opt, global_tree["params"], deltas["params"], opt_state
         )
@@ -589,6 +630,7 @@ class PrimaryServer:
                 stacked,
                 weights,
                 self._server_opt_state,
+                jnp.asarray(len(self.history), jnp.int32),
             )
             self.params = new_global["params"]
             self.batch_stats = new_global["batch_stats"]

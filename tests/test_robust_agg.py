@@ -166,7 +166,8 @@ def test_distributed_edge_robust_aggregate_and_guards():
     )
     g = {"params": srv.params, "batch_stats": srv.batch_stats}
     out, _ = srv._aggregate(
-        g, deltas, jnp.ones((3,)), srv._server_opt_state
+        g, deltas, jnp.ones((3,)), srv._server_opt_state,
+        jnp.asarray(0, jnp.int32),
     )
     for a, b in zip(
         jax.tree_util.tree_leaves(out["params"]),

@@ -147,7 +147,8 @@ def test_replica_payload_carries_server_moments():
     )
     g = {"params": src.params, "batch_stats": src.batch_stats}
     out, src._server_opt_state = src._aggregate(
-        g, deltas, jnp.asarray([1.0]), src._server_opt_state
+        g, deltas, jnp.asarray([1.0]), src._server_opt_state,
+        jnp.asarray(0, jnp.int32),
     )
     src.params = out["params"]
 
@@ -193,7 +194,8 @@ def test_distributed_edge_applies_server_opt():
 
     def agg(srv):
         g = {"params": srv.params, "batch_stats": srv.batch_stats}
-        out, _ = srv._aggregate(g, deltas, w, srv._server_opt_state)
+        out, _ = srv._aggregate(g, deltas, w, srv._server_opt_state,
+                                jnp.asarray(0, jnp.int32))
         return out["params"]
 
     p_plain, p_degen, p_adam = agg(plain), agg(degen), agg(adam)

@@ -75,9 +75,15 @@ def run_engine(args, n_dev):
         losses.append(round(float(m.loss), 6))
     assert int(m.num_active) == NUM_CLIENTS
     assert losses[-1] < losses[0], losses
+    # The fused multi-round scan over the SAME multi-controller mesh: 2 more
+    # rounds as one shard_map program, per-round psum over both processes.
+    stacked = fed.run_on_device(2)
+    fused = [round(float(stacked.loss[i]), 6) for i in range(2)]
+    assert int(fed.state.round_idx) == 5
+    assert fused[-1] <= losses[-1] + 1e-6, (losses, fused)
     print(
         f"multihost engine ok: process {args.process_id}/{NUM_PROCESSES}, "
-        f"{n_dev} global devices, losses={losses}",
+        f"{n_dev} global devices, losses={losses}, fused={fused}",
         flush=True,
     )
 

@@ -81,5 +81,9 @@ def test_two_process_federation_engine():
     """The high-level Federation engine itself over two controllers: mesh
     spanning both processes, sharded per-client state, on-device gather,
     cross-process psum FedAvg, converging loss — and both controllers agree
-    on every round's aggregate."""
+    on every round's aggregate. The run ends with the fused multi-round
+    scan (run_on_device) over the same multi-controller mesh; both
+    controllers must agree on its stacked losses too."""
+    # The agree check on "losses=" covers the whole suffix of the status
+    # line, which includes the fused list — one assertion, both values.
     _run_and_check("multihost engine ok", "losses=", extra=["--engine"])

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Bounded TPU health probe for the wedge-prone tunnel backend.
+
+The axon tunnel device can wedge indefinitely (observed: concurrent access,
+or killing a client mid-operation) — after which even ``jax.devices()``
+hangs. This probe runs the check in a child process with a hard timeout so
+it can NEVER hang the caller, and exits 0 (healthy: prints device kind +
+matmul result), 2 (unreachable/wedged), or 3 (backend error).
+
+Usage: ``python tools/tpu_probe.py [--timeout 90]``
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+
+_CHILD = """
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((256, 256))
+print(d.device_kind, "|", float((x @ x).sum()))
+"""
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout", type=float, default=90.0)
+    args = p.parse_args()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD],
+            capture_output=True,
+            text=True,
+            timeout=args.timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"healthy": False, "reason": f"timeout {args.timeout}s (wedged)"}))
+        return 2
+    if proc.returncode != 0:
+        print(json.dumps({"healthy": False, "reason": proc.stderr.strip()[-500:]}))
+        return 3
+    print(json.dumps({"healthy": True, "probe": proc.stdout.strip().splitlines()[-1]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,60 @@
+"""CLI run loop — fused-block eval/checkpoint cadences.
+
+--fused N honors eval/checkpoint cadences by interval-crossing at block
+boundaries (mid-block model states never exist on the host); these tests pin
+the exact rounds that get evals and the exact checkpoint files written.
+"""
+
+import json
+import os
+
+from fedtpu.cli import run as cli_run
+
+
+def _read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh]
+
+
+def test_fused_cadences_write_expected_evals_and_checkpoints(tmp_path):
+    metrics = str(tmp_path / "m.jsonl")
+    ckpt = str(tmp_path / "ckpt")
+    rc = cli_run.main([
+        "--platform", "cpu",
+        "--model", "mlp", "--dataset", "synthetic",
+        "--num-clients", "3", "--rounds", "10", "--num-examples", "192",
+        "--batch-size", "4", "--steps-per-round", "2", "--lr", "0.05",
+        "--partition", "iid",
+        "--fused", "4", "--eval-every", "5",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "4",
+        "--metrics", metrics,
+    ])
+    assert rc == 0
+    rows = _read_jsonl(metrics)
+    assert [r["step"] for r in rows] == list(range(10))
+    # Blocks end after rounds 4, 8, 10; eval-every=5 crossings land on the
+    # last round of the crossing block: rounds 7 (block 4..7) and 9 (8..9).
+    eval_rounds = [r["step"] for r in rows if "test_acc" in r]
+    assert eval_rounds == [7, 9], eval_rounds
+    # checkpoint-every=4 crossings at block boundaries 4 and 8, plus the
+    # final-round save at 10.
+    assert sorted(os.listdir(ckpt)) == [
+        "round_10.fckpt", "round_4.fckpt", "round_8.fckpt"
+    ]
+
+
+def test_fused_1_matches_per_round_cadence(tmp_path):
+    """--fused 1 must degrade to the exact per-round cadence semantics."""
+    metrics = str(tmp_path / "m.jsonl")
+    rc = cli_run.main([
+        "--platform", "cpu",
+        "--model", "mlp", "--dataset", "synthetic",
+        "--num-clients", "2", "--rounds", "6", "--num-examples", "128",
+        "--batch-size", "4", "--steps-per-round", "2", "--lr", "0.05",
+        "--partition", "iid",
+        "--eval-every", "2",
+        "--metrics", metrics,
+    ])
+    assert rc == 0
+    rows = _read_jsonl(metrics)
+    assert [r["step"] for r in rows if "test_acc" in r] == [1, 3, 5]

@@ -15,7 +15,6 @@ clock (the reference's only test was manually killing processes, SURVEY §4).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np

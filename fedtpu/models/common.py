@@ -10,7 +10,7 @@ stats alongside weights (``src/server.py:163-171``).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

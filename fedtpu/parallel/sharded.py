@@ -9,8 +9,7 @@ XLA lowers it to ICI all-reduces with zero host involvement.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import flax.linen as nn
 import jax

@@ -109,10 +109,11 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--aggregator",
         default="mean",
-        choices=["mean", "median", "trimmed_mean"],
+        choices=["mean", "median", "trimmed_mean", "krum"],
         help="delta combine rule: mean = (weighted) FedAvg (reference "
         "semantics); median / trimmed_mean = coordinate-wise "
-        "Byzantine-robust aggregation",
+        "Byzantine-robust aggregation; krum = selection-based "
+        "(Blanchard et al. 2017)",
     )
     p.add_argument("--trim-fraction", default=0.1, type=float)
     p.add_argument(

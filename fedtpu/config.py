@@ -106,9 +106,11 @@ class FedConfig:
     server_eps: float = 1e-8
     # How client deltas combine. "mean" is the reference's (weighted) FedAvg;
     # "median" / "trimmed_mean" are coordinate-wise Byzantine-robust
-    # aggregators (Yin et al. 2018) — they ignore example-count weights by
-    # construction and tolerate up to ~trim_fraction of adversarial clients.
-    aggregator: str = "mean"  # mean | median | trimmed_mean
+    # aggregators (Yin et al. 2018); "krum" is selection-based (Blanchard et
+    # al. 2017, f = floor(trim_fraction * n) assumed Byzantine, pairwise
+    # distances as one MXU matmul). Robust aggregators ignore example-count
+    # weights by construction and tolerate ~trim_fraction adversaries.
+    aggregator: str = "mean"  # mean | median | trimmed_mean | krum
     trim_fraction: float = 0.1
     # Differential privacy (DP-FedAvg, McMahan et al. 2018): clip each
     # client's delta to L2 norm dp_clip_norm (0 = off), then add Gaussian

@@ -297,10 +297,10 @@ class PrimaryServer:
         self.batch_stats = variables.get("batch_stats", {})
         from fedtpu.core import server_opt as server_opt_lib
 
-        if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean"):
+        if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean", "krum"):
             raise ValueError(
                 f"unknown aggregator {cfg.fed.aggregator!r}; "
-                "have mean | median | trimmed_mean"
+                "have mean | median | trimmed_mean | krum"
             )
         if cfg.fed.aggregator != "mean":
             if cfg.fed.compression != "none":
@@ -404,13 +404,25 @@ class PrimaryServer:
                 )
             return out.astype(d.dtype)
 
-        combine = mean if fed.aggregator == "mean" else robust
         if fed.dp_clip_norm > 0:
             stacked_deltas = dict(
                 stacked_deltas,
                 params=_dp_clip(stacked_deltas["params"], fed.dp_clip_norm),
             )
-        deltas = jax.tree.map(combine, stacked_deltas)
+        if fed.aggregator == "krum":
+            from fedtpu.core.round import _krum_over_clients
+
+            # Joint selection over params + stats; the stack holds only
+            # successful replies, so every row is "alive".
+            deltas = _krum_over_clients(
+                stacked_deltas,
+                jnp.ones((weights.shape[0],), jnp.float32),
+                None,
+                fed.trim_fraction,
+            )
+        else:
+            combine = mean if fed.aggregator == "mean" else robust
+            deltas = jax.tree.map(combine, stacked_deltas)
         if fed.dp_clip_norm > 0 and fed.dp_noise_multiplier > 0:
             n = jnp.asarray(weights.shape[0], jnp.float32)
             std = fed.dp_clip_norm * fed.dp_noise_multiplier / jnp.maximum(n, 1.0)

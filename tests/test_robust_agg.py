@@ -125,9 +125,167 @@ def test_robust_mesh_matches_single_program(eight_devices):
 
 
 def test_unknown_aggregator_raises():
-    cfg = _cfg(aggregator="krum")
+    cfg = _cfg(aggregator="bulyan")
     with pytest.raises(ValueError, match="unknown aggregator"):
         Federation(cfg, seed=0).step()
+
+
+def test_krum_selects_the_cluster_member():
+    """5 clients: 4 clustered near delta=1, one far outlier — Krum must
+    return one of the clustered deltas verbatim."""
+    from fedtpu.core.round import _krum_over_clients
+
+    rng = np.random.default_rng(0)
+    base = np.ones((4, 6), np.float32) + 0.01 * rng.normal(size=(4, 6)).astype(
+        np.float32
+    )
+    outlier = np.full((1, 6), 500.0, np.float32)
+    x = np.concatenate([base[:2], outlier, base[2:]])
+    out = _krum_over_clients(
+        {"a": jnp.asarray(x)}, jnp.ones((5,)), None, 0.2
+    )["a"]
+    matches = [np.allclose(np.asarray(out), row, atol=1e-6) for row in base]
+    assert any(matches), np.asarray(out)
+
+
+def test_krum_excludes_dead_clients_and_all_dead_is_noop():
+    from fedtpu.core.round import _krum_over_clients
+
+    x = np.stack([
+        np.full((4,), 1.0, np.float32),
+        np.full((4,), 1.01, np.float32),
+        np.full((4,), 900.0, np.float32),  # would win if dead rows counted
+        np.full((4,), 0.99, np.float32),
+    ])
+    w = np.asarray([1.0, 1.0, 0.0, 1.0], np.float32)
+    out = _krum_over_clients({"a": jnp.asarray(x)}, jnp.asarray(w), None, 0.0)["a"]
+    assert float(np.abs(np.asarray(out)).max()) < 2.0
+    zero = _krum_over_clients(
+        {"a": jnp.asarray(x)}, jnp.zeros((4,)), None, 0.0
+    )["a"]
+    np.testing.assert_array_equal(np.asarray(zero), 0.0)
+
+
+def test_krum_with_many_dead_clients_still_discriminates():
+    """Regression: with dead > f+1, a k computed from the TOTAL row count
+    pulls _KRUM_BIG distances into every live score, flattening them all to
+    ~k*1e30 in f32 and degrading argmin to 'first live index'. k must come
+    from the live count: here the first live row is the outlier and must
+    NOT be selected."""
+    from fedtpu.core.round import _krum_over_clients
+
+    x = np.stack([
+        np.full((4,), 700.0, np.float32),   # live outlier, lowest index
+        np.full((4,), 1.0, np.float32),
+        np.full((4,), 1.01, np.float32),
+        np.full((4,), 0.99, np.float32),
+        np.full((4,), 5000.0, np.float32),  # dead
+        np.full((4,), 6000.0, np.float32),  # dead
+        np.full((4,), 7000.0, np.float32),  # dead
+        np.full((4,), 8000.0, np.float32),  # dead
+    ])
+    w = np.asarray([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+    out = _krum_over_clients(
+        {"a": jnp.asarray(x)}, jnp.asarray(w), None, 0.1
+    )["a"]
+    assert float(np.abs(np.asarray(out)).max()) < 2.0, np.asarray(out)
+
+
+def test_krum_composes_with_nothing_unsound():
+    """DP's mean-only guard covers krum; compression guard covers krum."""
+    with pytest.raises(ValueError, match="mean aggregator"):
+        Federation(
+            _cfg(aggregator="krum", weighted=False, dp_clip_norm=0.1), seed=0
+        )
+    with pytest.raises(ValueError, match="cannot compose with"):
+        Federation(
+            _cfg(aggregator="krum", compression="topk"), seed=0
+        )
+
+
+def test_krum_selection_is_joint_across_trees():
+    """Krum must pick ONE client for all trees — mixing client A's params
+    with client B's stats would be incoherent."""
+    from fedtpu.core.round import _krum_over_clients
+
+    p = np.asarray([[1.0, 1.0], [1.02, 1.0], [50.0, 50.0]], np.float32)
+    s = np.asarray([[10.0], [20.0], [30.0]], np.float32)
+    out = _krum_over_clients(
+        {"p": jnp.asarray(p), "s": jnp.asarray(s)}, jnp.ones((3,)), None, 0.34
+    )
+    sel = int(np.argmin([np.abs(p[i] - np.asarray(out["p"])).max()
+                         for i in range(3)]))
+    np.testing.assert_allclose(np.asarray(out["s"]), s[sel])
+
+
+def test_krum_round_resists_adversarial_client():
+    norms = {}
+    for aggregator in ("mean", "krum"):
+        cfg = _cfg(aggregator=aggregator, trim_fraction=0.25)
+        probe = Federation(cfg, seed=0)
+        imgs = np.asarray(probe.images).copy()
+        labels = np.asarray(probe.labels).copy()
+        own = probe.client_idx[0][probe.client_mask[0]]
+        imgs[own] *= 50.0
+        labels[own] = (labels[own] + 5) % 10
+        fed = Federation(cfg, seed=0, data=(imgs, labels))
+        before = [np.asarray(x).copy() for x in
+                  jax.tree_util.tree_leaves(fed.state.params)]
+        fed.step()
+        after = jax.tree_util.tree_leaves(fed.state.params)
+        norms[aggregator] = float(
+            sum(np.abs(a - np.asarray(b)).sum() for a, b in zip(before, after))
+        )
+    assert norms["krum"] < norms["mean"] * 0.5, norms
+
+
+def test_krum_mesh_matches_single_program(eight_devices):
+    from fedtpu.parallel import client_mesh
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=8, aggregator="krum"),
+        steps_per_round=2,
+    )
+    single = Federation(cfg, seed=0)
+    meshed = Federation(cfg, seed=0, mesh=client_mesh(8))
+    single.step()
+    meshed.step()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(single.state.params),
+        jax.tree_util.tree_leaves(meshed.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_krum_distributed_edge():
+    from fedtpu.transport.federation import PrimaryServer
+
+    srv = PrimaryServer(_cfg(aggregator="krum"), clients=[], seed=0)
+    deltas = jax.tree.map(
+        lambda p: jnp.stack(
+            [jnp.ones_like(p) * 0.01, jnp.ones_like(p) * 0.0101,
+             jnp.ones_like(p) * 1000.0, jnp.ones_like(p) * 0.0099]
+        ),
+        {"params": srv.params, "batch_stats": srv.batch_stats},
+    )
+    g = {"params": srv.params, "batch_stats": srv.batch_stats}
+    out, _ = srv._aggregate(
+        g, deltas, jnp.ones((4,)), srv._server_opt_state,
+        jnp.asarray(0, jnp.int32),
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out["params"]),
+        jax.tree_util.tree_leaves(srv.params),
+    ):
+        move = np.abs(np.asarray(a) - np.asarray(b)).max()
+        assert move < 0.02, move
 
 
 def test_trimmed_mean_never_empties_the_band_at_small_n():

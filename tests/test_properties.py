@@ -1,0 +1,152 @@
+"""Property-based tests (hypothesis) for core invariants.
+
+The fixed-case oracles elsewhere pin known inputs; these generalise the
+invariants over randomized shapes/sizes: partitioners are exact disjoint
+covers, DP clipping always respects its bound, the threshold codec conserves
+mass exactly, robust combiners match NumPy on arbitrary masks, and the wire
+codec roundtrips arbitrary pytrees and detects corruption.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from fedtpu.core.round import _dp_clip, _robust_over_clients
+from fedtpu.data import partition
+from fedtpu.transport import wire
+
+_slow = settings(max_examples=25, deadline=None)
+
+
+@_slow
+@given(
+    n_examples=st.integers(4, 300),
+    n_clients=st.integers(1, 9),
+    batch=st.integers(1, 8),
+)
+def test_round_robin_is_an_exact_disjoint_cover(n_examples, n_clients, batch):
+    idx, mask = partition.round_robin(n_examples, n_clients, batch)
+    taken = idx[mask]
+    n_batches = n_examples // batch  # trailing partial batch is dropped
+    assert sorted(taken.tolist()) == list(range(n_batches * batch))
+
+
+@_slow
+@given(n_examples=st.integers(2, 400), n_clients=st.integers(1, 10),
+       seed=st.integers(0, 5))
+def test_iid_is_an_exact_disjoint_cover(n_examples, n_clients, seed):
+    idx, mask = partition.iid(n_examples, n_clients, seed=seed)
+    taken = sorted(idx[mask].tolist())
+    assert taken == list(range(n_examples))
+
+
+@_slow
+@given(n=st.integers(20, 200), clients=st.integers(2, 8),
+       alpha=st.floats(0.1, 5.0), seed=st.integers(0, 3))
+def test_dirichlet_is_an_exact_disjoint_cover(n, clients, alpha, seed):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    idx, mask = partition.dirichlet(labels, clients, alpha=alpha, seed=seed)
+    assert sorted(idx[mask].tolist()) == list(range(n))
+
+
+@_slow
+@given(
+    rows=st.integers(1, 6),
+    cols=st.integers(1, 40),
+    clip=st.floats(1e-3, 10.0),
+    scale=st.floats(0.01, 100.0),
+    seed=st.integers(0, 10),
+)
+def test_dp_clip_bound_always_holds(rows, cols, clip, scale, seed):
+    rng = np.random.default_rng(seed)
+    tree = {
+        "a": jnp.asarray(scale * rng.normal(size=(rows, cols)).astype(np.float32)),
+        "b": jnp.asarray(scale * rng.normal(size=(rows, 3)).astype(np.float32)),
+    }
+    clipped = _dp_clip(tree, clip)
+    sq = sum(
+        np.sum(np.square(np.asarray(x, np.float64)), axis=1)
+        for x in jax.tree_util.tree_leaves(clipped)
+    )
+    assert (np.sqrt(sq) <= clip * (1 + 1e-4) + 1e-7).all()
+
+
+@_slow
+@given(
+    n=st.integers(1, 9),
+    cols=st.integers(1, 30),
+    n_dead=st.integers(0, 3),
+    seed=st.integers(0, 10),
+)
+def test_masked_median_matches_numpy(n, cols, n_dead, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, cols)).astype(np.float32)
+    w = np.ones((n,), np.float32)
+    dead = rng.choice(n, size=min(n_dead, n - 1) if n > 1 else 0, replace=False)
+    w[dead] = 0.0
+    out = _robust_over_clients(
+        {"a": jnp.asarray(x)}, jnp.asarray(w), None, "median", 0.1
+    )["a"]
+    expect = np.median(x[w > 0], axis=0)
+    np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5)
+
+
+def _tree_strategy():
+    arr = st.integers(1, 12).flatmap(
+        lambda k: st.integers(0, 6).map(
+            lambda s: np.arange(k * (s + 1), dtype=np.float32).reshape(
+                (k, s + 1)
+            )
+        )
+    )
+    return st.dictionaries(
+        st.sampled_from(["w", "b", "m", "v"]), arr, min_size=1, max_size=4
+    )
+
+
+@_slow
+@given(tree=_tree_strategy(), compress=st.booleans())
+def test_wire_roundtrip_arbitrary_trees(tree, compress):
+    blob = wire.encode(tree, compress=compress)
+    like = {k: np.zeros_like(v) for k, v in tree.items()}
+    out = wire.decode(blob, like)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out[k]), tree[k])
+
+
+@_slow
+@given(tree=_tree_strategy(), pos_frac=st.floats(0.0, 1.0))
+def test_wire_detects_payload_corruption(tree, pos_frac):
+    blob = bytearray(wire.encode(tree, compress=False))
+    header = 10  # magic(4) + version(1) + flags(1) + crc(4)
+    if len(blob) <= header:
+        return
+    pos = header + int(pos_frac * (len(blob) - header - 1))
+    blob[pos] ^= 0xFF
+    like = {k: np.zeros_like(v) for k, v in tree.items()}
+    with pytest.raises(ValueError):
+        wire.decode(bytes(blob), like)
+
+
+@_slow
+@given(
+    n=st.integers(2, 8),
+    cols=st.integers(2, 20),
+    trim=st.floats(0.0, 0.45),
+    seed=st.integers(0, 10),
+)
+def test_trimmed_mean_stays_within_live_range(n, cols, trim, seed):
+    """The trimmed mean of live clients always lies within [min, max] of the
+    live values per coordinate, and the band is never empty."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, cols)).astype(np.float32) * 10
+    out = np.asarray(
+        _robust_over_clients(
+            {"a": jnp.asarray(x)}, jnp.ones((n,)), None, "trimmed_mean", trim
+        )["a"]
+    )
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    assert (out >= lo - 1e-4).all() and (out <= hi + 1e-4).all()

@@ -40,6 +40,16 @@ def main(argv=None) -> int:
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
+    p.add_argument(
+        "--round-deadline",
+        default=None,
+        type=float,
+        metavar="SECONDS",
+        help="straggler mitigation: aggregate whatever StartTrain replies "
+        "arrived within this budget instead of blocking on the slowest "
+        "client (stragglers stay alive and rejoin next round). Default: "
+        "wait indefinitely (reference behavior, src/server.py:132-135)",
+    )
     args = p.parse_args(argv)
     apply_platform_flag(args)
 
@@ -56,6 +66,7 @@ def main(argv=None) -> int:
             clients,
             backup_address=f"{args.backupAddress}:{args.backupPort}",
             compress=compress,
+            round_deadline_s=args.round_deadline,
         )
         ckpt = None
         start_round = 0
@@ -88,7 +99,9 @@ def main(argv=None) -> int:
         return 0
 
     backup = BackupServer(
-        cfg, clients, compress=compress, watchdog_timeout=args.watchdog_timeout
+        cfg, clients, compress=compress,
+        watchdog_timeout=args.watchdog_timeout,
+        round_deadline_s=args.round_deadline,
     )
     server = backup.start(args.listen)
     logging.info("backup serving on %s", args.listen)

@@ -284,10 +284,18 @@ class PrimaryServer:
         seed: int = 0,
         initial_model: Optional[bytes] = None,
         rpc_timeout: float = 600.0,
+        round_deadline_s: Optional[float] = None,
     ):
+        """``round_deadline_s``: straggler mitigation — wait at most this
+        long for StartTrain replies each round, then aggregate whatever
+        arrived. Stragglers stay ALIVE (they still get the broadcast and
+        rejoin next round), unlike RpcError failures; the reference's
+        barrier blocks on its slowest client unconditionally
+        (``src/server.py:132-135``). None = reference behavior."""
         self.cfg = cfg
         self.compress = compress
         self.rpc_timeout = rpc_timeout
+        self.round_deadline_s = round_deadline_s
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
         shape = dataset_info(cfg.data.dataset)[0]
         variables = self.model.init(
@@ -361,6 +369,9 @@ class PrimaryServer:
         self._aggregate = jax.jit(self._aggregate_impl)
         self.history: List[dict] = []
         self._did_initial_sync = False
+        # Straggler StartTrain threads still in flight from earlier rounds,
+        # keyed by client (see round()).
+        self._inflight: Dict[str, threading.Thread] = {}
 
     # ----------------------------------------------------------- aggregation
     def _aggregate_impl(
@@ -616,24 +627,62 @@ class PrimaryServer:
                 )
                 self.registry.mark_failed(client)
 
-        threads = [
-            threading.Thread(target=train_one, args=(rank, client))
-            for rank, client in enumerate(active)
+        # A straggler whose previous-round StartTrain is STILL in flight must
+        # not be handed a second concurrent StartTrain (the two handlers
+        # would race on the client's trainer state / error-feedback
+        # residual); it sits this round out and rejoins once its old call
+        # drains.
+        still_busy = [
+            c for c in active
+            if c in self._inflight and self._inflight[c].is_alive()
         ]
-        for t in threads:
+        if still_busy:
+            log.warning("stragglers still in flight, skipping: %s", still_busy)
+        launch = [c for c in active if c not in still_busy]
+        threads = {
+            client: threading.Thread(target=train_one, args=(rank, client))
+            for rank, client in enumerate(launch)
+        }
+        for t in threads.values():
             t.start()
-        for t in threads:
-            t.join()
+        if self.round_deadline_s is None:
+            for t in threads.values():
+                t.join()
+            stragglers = list(still_busy)
+        else:
+            deadline = time.monotonic() + self.round_deadline_s
+            for t in threads.values():
+                t.join(max(0.0, deadline - time.monotonic()))
+            stragglers = still_busy + [
+                c for c, t in threads.items() if t.is_alive()
+            ]
+            if stragglers:
+                log.warning(
+                    "round deadline %.1fs hit; aggregating without %s",
+                    self.round_deadline_s, stragglers,
+                )
+        self._inflight = {
+            c: t for c, t in threads.items() if t.is_alive()
+        }
 
-        if results:
-            order = [c for c in active if c in results]
+        # Snapshot completed replies under a NEW name: train_one writes to
+        # the `results` free variable, so a straggler finishing
+        # mid-aggregation lands its late write in the discarded per-round
+        # dict, never in this round's inputs.
+        completed = {
+            c: results[c]
+            for c in active
+            if c in results and c not in stragglers
+        }
+        if completed:
+            order = [c for c in active if c in completed]
             stacked = jax.tree.map(
                 lambda *leaves: jnp.stack(leaves),
-                *[results[c][0] for c in order],
+                *[completed[c][0] for c in order],
             )
             if cfg.fed.weighted:
                 weights = jnp.asarray(
-                    [results[c][1] for c in order], jnp.float32
+                    [completed[c][1] for c in order], jnp.float32
                 )
             else:
                 weights = jnp.ones((len(order),), jnp.float32)
@@ -676,17 +725,27 @@ class PrimaryServer:
                 )
                 self.registry.mark_failed(client)
 
-        threads = [
+        send_threads = [
             threading.Thread(target=send_one, args=(c,))
             for c in self.registry.active_clients()
         ]
-        for t in threads:
+        for t in send_threads:
             t.start()
-        for t in threads:
-            t.join()
+        if self.round_deadline_s is None:
+            for t in send_threads:
+                t.join()
+        else:
+            # The broadcast gets its own deadline budget too — an overloaded
+            # client's slow SendModel+eval must not re-introduce the
+            # blocking-on-slowest behavior the flag removes. A send still in
+            # flight simply keeps running; RpcError marks failure as usual.
+            deadline = time.monotonic() + self.round_deadline_s
+            for t in send_threads:
+                t.join(max(0.0, deadline - time.monotonic()))
 
         rec = {
-            "participants": len(results),
+            "participants": len(completed),
+            "stragglers": len(stragglers),
             "world": world,
             "alive": self.registry.alive_mask().tolist(),
             # Wire accounting (successful transfers only) — the reference
@@ -749,10 +808,14 @@ class BackupServer(TrainerServicer):
         clients: List[str],
         compress: bool = False,
         watchdog_timeout: float = 10.0,
+        round_deadline_s: Optional[float] = None,
     ):
         self.cfg = cfg
         self.clients = clients
         self.compress = compress
+        # Forwarded to the acting PrimaryServer on promotion, so straggler
+        # mitigation survives failover.
+        self.round_deadline_s = round_deadline_s
         self.latest_model: Optional[bytes] = None
         self.acting: Optional[PrimaryServer] = None
         self.machine = FailoverStateMachine(
@@ -801,6 +864,7 @@ class BackupServer(TrainerServicer):
             self.clients,
             compress=self.compress,
             initial_model=self.latest_model,
+            round_deadline_s=self.round_deadline_s,
         )
         self.acting = acting
 

@@ -244,3 +244,63 @@ def test_backup_promotes_and_demotes(two_clients):
     finally:
         backup.watchdog.stop()
         backup_server.stop(0)
+
+def test_round_deadline_skips_stragglers_without_killing_them():
+    """A client whose StartTrain exceeds the round deadline is aggregated
+    around (not marked dead): participants drops, stragglers is reported,
+    alive stays true, and the slow client still receives the broadcast."""
+    import time as _time
+
+    from fedtpu.transport.federation import ClientAgent
+    from fedtpu.transport.service import create_server
+
+    cfg = tiny_cfg()
+
+    class SlowAgent(ClientAgent):
+        """Sleeps from the SECOND StartTrain on: the first (deadline-free)
+        warmup round absorbs jit compilation on both clients, so the timed
+        round's deadline races only the sleep, not a compiler."""
+
+        calls = 0
+
+        def StartTrain(self, request, context):
+            SlowAgent.calls += 1
+            if SlowAgent.calls > 1:
+                _time.sleep(8.0)
+            return super().StartTrain(request, context)
+
+    addrs, servers, agents = [], [], []
+    for i, cls in enumerate([ClientAgent, SlowAgent]):
+        addr = f"localhost:{free_port()}"
+        agent = cls(cfg, seed=i)
+        server = create_server(addr, agent)
+        server.start()
+        addrs.append(addr)
+        servers.append(server)
+        agents.append(agent)
+    try:
+        primary = PrimaryServer(cfg, addrs, round_deadline_s=None)
+        warm = primary.round()  # compile both clients, no deadline
+        assert warm["participants"] == 2
+        primary.round_deadline_s = 3.0
+        t0 = time.monotonic()
+        rec = primary.round()
+        elapsed = time.monotonic() - t0
+        assert rec["participants"] == 1
+        assert rec["stragglers"] == 1
+        assert rec["alive"] == [True, True], rec
+        assert elapsed < 8.0, elapsed  # did not block on the slow client
+        # Warmup's broadcast reached both; the straggler round's broadcast
+        # still targets the straggler (it stays active).
+        assert agents[1].last_eval is not None
+        # Immediate next round: the straggler's StartTrain is STILL in
+        # flight, so it is skipped (no second concurrent call on its
+        # trainer) and reported as a straggler again.
+        calls_before = SlowAgent.calls
+        rec2 = primary.round()
+        assert rec2["participants"] == 1
+        assert rec2["stragglers"] == 1
+        assert SlowAgent.calls == calls_before
+    finally:
+        for s in servers:
+            s.stop(0)

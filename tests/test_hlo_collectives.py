@@ -1,0 +1,69 @@
+"""Compiler-level pinning of the sharded program's collective structure.
+
+The mesh path's whole point is that aggregation happens as XLA collectives
+over the interconnect. A refactor that silently drops the psum (e.g. an
+axis_name that stops reaching `_mean_over_clients`) would still produce
+running code — each shard would just average its local clients only — so
+these tests inspect the COMPILED HLO: the mean path must contain
+all-reduces and no all-gathers; the robust path must gather.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu import models
+from fedtpu.core import round as round_lib
+from fedtpu.parallel import (
+    client_mesh,
+    make_sharded_round_step,
+    shard_batch,
+    shard_state,
+)
+
+
+def _compiled_hlo(aggregator, eight_devices):
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(),
+        data=DataConfig(dataset="synthetic", batch_size=4),
+        fed=FedConfig(num_clients=8, aggregator=aggregator),
+        steps_per_round=2,
+    )
+    m = models.create("mlp", num_classes=10)
+    state = round_lib.init_state(
+        m, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    mesh = client_mesh(8)
+    rng = np.random.default_rng(0)
+    batch = round_lib.RoundBatch(
+        x=jnp.asarray(rng.normal(size=(8, 2, 4, 32, 32, 3)).astype(np.float32)),
+        y=jnp.asarray(rng.integers(0, 10, size=(8, 2, 4)).astype(np.int32)),
+        step_mask=jnp.ones((8, 2), bool),
+        weights=jnp.ones((8,)),
+        alive=jnp.ones((8,), bool),
+    )
+    step = make_sharded_round_step(m, cfg, mesh, donate=False)
+    compiled = step.lower(
+        shard_state(state, mesh, cfg.mesh_axis),
+        shard_batch(batch, mesh, cfg.mesh_axis),
+    ).compile()
+    return compiled.as_text()
+
+
+def test_mean_path_aggregates_via_all_reduce(eight_devices):
+    hlo = _compiled_hlo("mean", eight_devices)
+    assert hlo.count("all-reduce") > 0, "FedAvg psum vanished from the HLO"
+    assert hlo.count("all-gather") == 0, (
+        "mean aggregation should never materialise the full client axis"
+    )
+
+
+def test_median_path_gathers_the_client_axis(eight_devices):
+    hlo = _compiled_hlo("median", eight_devices)
+    assert hlo.count("all-gather") > 0, (
+        "robust aggregation needs the global client axis (all_gather)"
+    )

@@ -160,3 +160,46 @@ def test_full_failover_cycle():
     clock.advance(11.0)
     assert m.check_watchdog()
     assert m.role is Role.ACTING_PRIMARY
+
+def test_chaos_kill_revive_schedule_still_converges():
+    """Randomized fault schedule over 20 rounds: every round each client
+    flips dead/alive with some probability (at least one always lives).
+    Training must stay finite, count participants correctly, and still
+    reach a better loss than round 0 — the simulated form of the
+    reference's manual kill/restart drills (SURVEY SS4)."""
+    import numpy as np
+    import jax
+
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core import Federation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, partition="iid",
+            num_examples=512,
+        ),
+        fed=FedConfig(num_clients=6),
+        steps_per_round=2,
+    )
+    fed = Federation(cfg, seed=0)
+    rng = np.random.default_rng(7)
+    first = None
+    for r in range(20):
+        alive = rng.random(6) > 0.35
+        if not alive.any():
+            alive[rng.integers(6)] = True
+        for c in range(6):
+            fed.set_alive(c, bool(alive[c]))
+        m = fed.step()
+        assert int(m.num_active) == int(alive.sum())
+        loss = float(m.loss)
+        assert np.isfinite(loss)
+        if first is None:
+            first = loss
+    assert int(fed.state.round_idx) == 20
+    for leaf in jax.tree_util.tree_leaves(fed.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert float(m.loss) < first, (first, float(m.loss))

@@ -58,10 +58,24 @@ class FederatedState(NamedTuple):
 
 
 class RoundMetrics(NamedTuple):
+    """``loss``/``accuracy`` average over ACTIVE clients; ``per_client_loss``
+    is the raw ``[clients]`` vector (0 for dead/unsampled clients) — the
+    observability hook for spotting a diverging or poisoned client, which
+    pairs with the robust aggregators. The reference can only print
+    per-batch console lines inside each client process
+    (``src/utils.py:51-92``).
+
+    Multi-controller caveat: unlike the replicated scalars,
+    ``per_client_loss`` is SHARDED along the mesh's clients axis, so on a
+    mesh spanning processes each host can ``np.asarray`` only its local
+    slice; use ``jax.experimental.multihost_utils.process_allgather`` to
+    fetch the global vector."""
+
     loss: jnp.ndarray
     accuracy: jnp.ndarray
     num_active: jnp.ndarray
     update_norm: jnp.ndarray
+    per_client_loss: jnp.ndarray
 
 
 class RoundBatch(NamedTuple):
@@ -521,6 +535,7 @@ def make_round_step(
             accuracy=acc_sum / n_active,
             num_active=n_alive,
             update_norm=trees.tree_norm(mean_delta),
+            per_client_loss=out.loss * alive_f,
         )
         new_state = FederatedState(
             params=new_params,

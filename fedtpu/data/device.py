@@ -226,7 +226,15 @@ def _shard_wrap(body, cfg: RoundConfig, mesh, alive_ndim: int, donate: bool):
             P(axis) if alive_ndim == 1 else P(None, axis),  # alive
             P(),                # data_key
         ),
-        out_specs=(state_specs(axis), RoundMetrics(P(), P(), P(), P())),
+        out_specs=(
+            state_specs(axis),
+            # Scalar metrics replicate; per_client_loss shards on its client
+            # axis — axis 0 for one round, axis 1 when the scan stacks [R, n].
+            RoundMetrics(
+                P(), P(), P(), P(),
+                P(axis) if alive_ndim == 1 else P(None, axis),
+            ),
+        ),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

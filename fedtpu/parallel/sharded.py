@@ -71,7 +71,10 @@ def make_sharded_round_step(
         body,
         mesh=mesh,
         in_specs=(state_specs(axis), batch_specs(axis)),
-        out_specs=(state_specs(axis), RoundMetrics(P(), P(), P(), P())),
+        out_specs=(
+            state_specs(axis),
+            RoundMetrics(P(), P(), P(), P(), P(axis)),
+        ),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -75,3 +75,66 @@ def test_multihost_helpers_single_process():
     assert multihost.is_coordinator()
     s = multihost.local_client_slice(8)
     assert (s.start, s.stop) == (0, 8)
+
+def test_per_client_loss_vector_flags_the_outlier():
+    """per_client_loss exposes which client diverges — the observability
+    hook that pairs with robust aggregation."""
+    import numpy as np
+    import jax
+
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core import Federation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=96,
+        ),
+        fed=FedConfig(num_clients=3),
+        steps_per_round=2,
+    )
+    probe = Federation(cfg, seed=0)
+    imgs = np.asarray(probe.images).copy()
+    labels = np.asarray(probe.labels).copy()
+    own = probe.client_idx[1][probe.client_mask[1]]
+    imgs[own] *= 40.0  # client 1 ships garbage
+    fed = Federation(cfg, seed=0, data=(imgs, labels))
+    fed.set_alive(2, False)
+    m = fed.step()
+    pcl = np.asarray(m.per_client_loss)
+    assert pcl.shape == (3,)
+    assert pcl[2] == 0.0                      # dead client masked out
+    assert pcl[1] == pcl.max() and pcl[1] > pcl[0] * 5, pcl
+    # Mean metric == masked mean of the vector.
+    np.testing.assert_allclose(float(m.loss), pcl[:2].mean(), rtol=1e-5)
+
+
+def test_per_client_loss_through_fused_scan_and_mesh(eight_devices):
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core import Federation
+    from fedtpu.parallel import client_mesh
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=8),
+        steps_per_round=2,
+    )
+    meshed = Federation(cfg, seed=0, mesh=client_mesh(8))
+    stacked = meshed.run_on_device(2)
+    pcl = np.asarray(stacked.per_client_loss)
+    assert pcl.shape == (2, 8)
+    assert np.isfinite(pcl).all()
+    single = Federation(cfg, seed=0)
+    s = single.run_on_device(2)
+    np.testing.assert_allclose(pcl, np.asarray(s.per_client_loss), atol=1e-5)

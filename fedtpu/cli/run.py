@@ -101,23 +101,26 @@ def main(argv=None) -> int:
         r = start_round
         while r < cfg.fed.num_rounds:
             block = min(max(1, args.fused), cfg.fed.num_rounds - r)
-            if block > 1:
-                import numpy as np
+            import numpy as np
 
+            if block > 1:
                 stacked = fed.run_on_device(block)
-                # Three bulk transfers, not 3*block scalar fetches — per-round
+                # Bulk transfers, not per-round scalar fetches — per-round
                 # float() would re-add the host round-trips fusion removes.
                 losses = np.asarray(stacked.loss)
                 accs = np.asarray(stacked.accuracy)
                 actives = np.asarray(stacked.num_active)
+                worsts = np.asarray(stacked.per_client_loss).max(axis=1)
                 per_round = [
-                    (float(losses[i]), float(accs[i]), float(actives[i]))
+                    (float(losses[i]), float(accs[i]), float(actives[i]),
+                     float(worsts[i]))
                     for i in range(block)
                 ]
             else:
                 m = fed.step()
                 per_round = [
-                    (float(m.loss), float(m.accuracy), float(m.num_active))
+                    (float(m.loss), float(m.accuracy), float(m.num_active),
+                     float(np.asarray(m.per_client_loss).max()))
                 ]
             # Eval/checkpoint cadences in fused mode: mid-block model states
             # never exist on the host, so a cadence point inside a block is
@@ -127,12 +130,13 @@ def main(argv=None) -> int:
             crossed_eval = args.eval_every and (
                 (r + block) // args.eval_every > r // args.eval_every
             )
-            for i, (loss, acc, active) in enumerate(per_round):
+            for i, (loss, acc, active, worst) in enumerate(per_round):
                 ri = r + i
                 rec = {
                     "loss": loss,
                     "acc": acc,
                     "active": active,
+                    "worst_client_loss": worst,
                     "dataset": cfg.data.dataset,
                     # 'synthetic' marks loader-fallback runs: their accuracy
                     # curves are not comparable to real-data results.

@@ -370,6 +370,11 @@ class Federation:
                 "loss": metrics.loss,
                 "acc": metrics.accuracy,
                 "active": metrics.num_active,
+                # Worst live client this round — a diverging/poisoned client
+                # shows up here rounds before it drags the mean.
+                "worst_client_loss": float(
+                    jnp.max(metrics.per_client_loss)
+                ),
                 "round_s": time.time() - t0,
                 "dataset": self.cfg.data.dataset,
                 # 'synthetic' when the loader fell back — accuracy curves from

@@ -12,6 +12,8 @@ import argparse
 import logging
 import time
 
+import numpy as np
+
 from fedtpu.checkpoint import Checkpointer
 from fedtpu.cli.common import add_fed_flags, add_model_flags, add_platform_flag, apply_platform_flag, build_config
 from fedtpu.core import Federation
@@ -101,8 +103,6 @@ def main(argv=None) -> int:
         r = start_round
         while r < cfg.fed.num_rounds:
             block = min(max(1, args.fused), cfg.fed.num_rounds - r)
-            import numpy as np
-
             if block > 1:
                 stacked = fed.run_on_device(block)
                 # Bulk transfers, not per-round scalar fetches — per-round

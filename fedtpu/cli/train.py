@@ -28,6 +28,14 @@ def main(argv=None) -> int:
                    help="best-accuracy checkpoint path")
     p.add_argument("-r", "--resume", action="store_true")
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
+    p.add_argument(
+        "--mesh",
+        default="off",
+        choices=["auto", "off"],
+        help="auto: when >1 device is visible and the batch divides evenly, "
+        "shard each batch across all devices with pmean'd grads (intra-node "
+        "data parallelism — the reference's DataParallel, src/main.py:79-81)",
+    )
     args = p.parse_args(argv)
     apply_platform_flag(args)
 
@@ -35,6 +43,16 @@ def main(argv=None) -> int:
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
     cfg = build_config(args, num_clients=1)
+    mesh = None
+    if args.mesh == "auto":
+        import jax
+
+        n_dev = len(jax.devices())
+        if n_dev > 1 and cfg.data.batch_size % n_dev == 0:
+            from fedtpu.parallel import client_mesh
+
+            mesh = client_mesh(axis_name="batch")
+            logging.info("batch axis sharded over %d devices", n_dev)
     trainer = run_solo(
         cfg,
         epochs=args.epochs,
@@ -42,6 +60,7 @@ def main(argv=None) -> int:
         checkpoint_path=args.checkpoint,
         resume=args.resume,
         logger=MetricsLogger(path=args.metrics),
+        mesh=mesh,
     )
     logging.info("best test accuracy: %.4f", trainer.best_acc)
     return 0

@@ -44,7 +44,23 @@ class SoloTrainer:
         seed: int = 0,
         checkpoint_path: Optional[str] = None,
         resume: bool = False,
+        mesh=None,
     ):
+        """``mesh``: optional 1-D ``jax.sharding.Mesh`` (any axis name) for
+        intra-node batch data parallelism: each step's batch shards across
+        the mesh, gradients/BN-stats/metrics pmean over it, and the
+        replicated update is identical on every device — the TPU-native
+        form of the reference's vestigial ``torch.nn.DataParallel`` wrap
+        (``src/main.py:79-81``; SURVEY §2d "intra-client DP"). The mesh
+        size must divide the batch size.
+
+        Numerics vs single-device: bit-identical for deterministic models
+        (no BN, no dropout, augment off — test-pinned on mlp). BatchNorm
+        models normalize each SHARD's sub-batch — the same semantics as
+        torch DataParallel, whose replicas also normalize their sub-batches
+        — so they match the reference's mechanism, not the single-device
+        trajectory (running stats here are the pmean over shards).
+        Dropout/augmentation RNG is fold_in-decorrelated per shard."""
         self.cfg = cfg
         self.model = model_zoo.create(
             cfg.model, num_classes=cfg.num_classes, remat=cfg.remat
@@ -64,13 +80,44 @@ class SoloTrainer:
         self.epoch = 0
         self.best_acc = 0.0
         self.checkpoint_path = checkpoint_path
-        self._train_step = jax.jit(self._make_train_step())
+        if mesh is None:
+            self._train_step = jax.jit(self._make_train_step())
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            if cfg.data.batch_size % mesh.devices.size:
+                raise ValueError(
+                    f"batch_size={cfg.data.batch_size} not divisible by "
+                    f"mesh size {mesh.devices.size}"
+                )
+            axis = mesh.axis_names[0]
+            body = self._make_train_step(axis_name=axis)
+            self._train_step = jax.jit(
+                jax.shard_map(
+                    body,
+                    mesh=mesh,
+                    in_specs=(
+                        P(),        # params (replicated)
+                        P(),        # batch_stats
+                        P(),        # opt_state
+                        P(axis),    # x — batch axis sharded
+                        P(axis),    # y
+                        P(),        # rng
+                        P(),        # epoch_idx
+                    ),
+                    out_specs=(P(), P(), P(), P(), P()),
+                    check_vma=False,
+                )
+            )
         self._evaluate = make_eval_fn(self.model.apply, cfg)
         if resume and checkpoint_path and os.path.exists(checkpoint_path):
             self.load_checkpoint(checkpoint_path)
 
     # ------------------------------------------------------------- training
-    def _make_train_step(self):
+    def _make_train_step(self, axis_name: Optional[str] = None):
+        """``axis_name`` set = the per-shard body for batch data
+        parallelism: grads/BN-stats/metrics pmean over the axis so the
+        (replicated) update matches the full-batch computation exactly."""
         cfg = self.cfg
         use_augment = cfg.data.augment and cfg.data.dataset in (
             "cifar10",
@@ -78,6 +125,11 @@ class SoloTrainer:
         )
 
         def loss_fn(params, batch_stats, x, y, rng):
+            if axis_name is not None:
+                # Decorrelate ALL per-shard randomness (augmentation crops
+                # and dropout masks); a replicated key would drop the same
+                # positions on every shard's sub-batch.
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
             if use_augment:
                 from fedtpu.data.augment import augment_batch
 
@@ -98,6 +150,11 @@ class SoloTrainer:
 
         def step(params, batch_stats, opt_state, x, y, rng, epoch_idx):
             (loss, (stats, acc)), grads = grad_fn(params, batch_stats, x, y, rng)
+            if axis_name is not None:
+                grads = jax.lax.pmean(grads, axis_name)
+                stats = jax.lax.pmean(stats, axis_name)
+                loss = jax.lax.pmean(loss, axis_name)
+                acc = jax.lax.pmean(acc, axis_name)
             lr = cfg.opt.lr_at(epoch_idx)
             params, opt_state = optim.apply(params, grads, opt_state, lr, cfg.opt)
             return params, stats, opt_state, loss, acc
@@ -187,9 +244,11 @@ def run_solo(
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
     logger: Optional[MetricsLogger] = None,
+    mesh=None,
 ) -> SoloTrainer:
     trainer = SoloTrainer(
-        cfg, seed=seed, checkpoint_path=checkpoint_path, resume=resume
+        cfg, seed=seed, checkpoint_path=checkpoint_path, resume=resume,
+        mesh=mesh,
     )
     for _ in range(epochs):
         tr_loss, tr_acc = trainer.train_epoch()

@@ -77,3 +77,55 @@ def test_kaiming_init_params():
     k = np.asarray(out["conv"]["kernel"])
     assert k.std() == pytest.approx(np.sqrt(2.0 / (16 * 9)), rel=0.2)
     np.testing.assert_array_equal(np.asarray(out["conv"]["bias"]), 0.0)
+
+def test_batch_parallel_solo_matches_single_device(tmp_path, eight_devices):
+    """Batch data parallelism (the reference's DataParallel, SURVEY §2d):
+    batch sharded over the mesh, grads/stats pmean'd — the trajectory must
+    match single-device training exactly (augment off)."""
+    import dataclasses
+
+    import numpy as np
+    import jax
+
+    from fedtpu.config import DataConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core.solo import SoloTrainer
+    from fedtpu.parallel import client_mesh
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=16, eval_batch_size=16,
+            num_examples=128, augment=False,
+        ),
+        fed=dataclasses.replace(RoundConfig().fed, num_clients=1),
+        steps_per_round=2,
+    )
+    single = SoloTrainer(cfg, seed=0)
+    meshed = SoloTrainer(cfg, seed=0, mesh=client_mesh(8, axis_name="batch"))
+    l1, a1 = single.train_epoch()
+    l2, a2 = meshed.train_epoch()
+    np.testing.assert_allclose(l1, l2, atol=1e-5)
+    np.testing.assert_allclose(a1, a2, atol=1e-6)
+    for x, y in zip(
+        jax.tree_util.tree_leaves(single.params),
+        jax.tree_util.tree_leaves(meshed.params),
+    ):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
+
+
+def test_batch_parallel_requires_divisible_batch(eight_devices):
+    import dataclasses
+
+    from fedtpu.config import DataConfig, OptimizerConfig, RoundConfig
+    from fedtpu.core.solo import SoloTrainer
+    from fedtpu.parallel import client_mesh
+
+    cfg = RoundConfig(
+        model="mlp", num_classes=10, opt=OptimizerConfig(),
+        data=DataConfig(dataset="synthetic", batch_size=12, num_examples=64),
+        fed=dataclasses.replace(RoundConfig().fed, num_clients=1),
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        SoloTrainer(cfg, seed=0, mesh=client_mesh(8, axis_name="batch"))

@@ -147,7 +147,7 @@ def quantdequant_int8(
 
     ``x: [rows, cols]``, ``scale: [rows]`` (per-client max|x|/127). The wire
     format for the DCN edge transmits the int8 codes + one f32 scale per leaf
-    (:mod:`fedtpu.transport.codec`); on-device FedAvg uses this fused
+    (``fedtpu.transport.sparse.encode_int8``); on-device FedAvg uses this fused
     quantize-dequantize so aggregation sees exactly the wire numbers.
     """
     rows, cols = x.shape

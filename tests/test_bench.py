@@ -1,0 +1,60 @@
+"""bench.py measurement-path regression.
+
+bench.py is the driver's headline artifact; a silent breakage there costs a
+whole round of evidence. This runs ``_measure`` at a shrunk configuration on
+the CPU platform (same code path as the chip: engine construction, AOT
+compile of the fused multi-round program, cost analysis, timed dispatches)
+and checks the JSON contract.
+"""
+
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    monkeypatch.syspath_prepend(".")
+    import bench as bench_mod
+
+    monkeypatch.setattr(bench_mod, "NUM_CLIENTS", 4)
+    monkeypatch.setattr(bench_mod, "STEPS_PER_ROUND", 2)
+    monkeypatch.setattr(bench_mod, "BATCH", 8)
+    monkeypatch.setattr(bench_mod, "TIMED_ROUNDS", 3)
+    monkeypatch.setattr(bench_mod, "TRIALS", 2)
+    return bench_mod
+
+
+def test_measure_contract(bench):
+    result = bench._measure()
+    assert result["metric"].startswith("fedavg_client_epochs_per_sec")
+    assert result["unit"] == "client-epochs/sec/chip"
+    assert result["value"] > 0
+    assert result["rounds_per_sec"] > 0
+    # Normalisation: value = rounds/sec * clients / devices.
+    assert result["value"] == pytest.approx(
+        result["rounds_per_sec"] * result["num_clients"] / result["n_devices"],
+        rel=1e-2,
+    )
+    # Both fields are independently rounded in the JSON (value to 3 dp,
+    # vs_baseline to 4 dp), so compare with an absolute slack of one ulp
+    # of the coarser rounding.
+    assert result["vs_baseline"] == pytest.approx(
+        result["value"] / bench.TARGET_PER_CHIP, abs=1e-3
+    )
+    # FLOPs come from the single-round program (scan-body accounting).
+    assert result.get("flops_per_round", 0) > 0
+
+
+def test_salvage_json_takes_last_valid_object(bench):
+    text = 'garbage\n{"a": 1}\nnot json\n{"metric": "x", "value": 1}\ntrailing'
+    assert bench._salvage_json(text) == '{"metric": "x", "value": 1}'
+    assert bench._salvage_json("no json here") is None
+    assert bench._salvage_json("") is None
+
+
+def test_peak_lookup_covers_observed_device_kinds(bench):
+    assert bench._peak_for("TPU v5 lite") == 197e12
+    assert bench._peak_for("TPU v5e") == 197e12
+    assert bench._peak_for("TPU v4") == 275e12
+    assert bench._peak_for("weird accelerator") is None

@@ -80,26 +80,30 @@ def restore(
         raise
 
 
-# State fields added after the first release of the wire format. Checkpoints
-# written before a field existed lack its key, and flax's from_bytes raises on
-# any key mismatch — so a failed decode retries with these dropped from the
-# template and refills them from ``like`` (i.e. their freshly-initialised
-# values, which is exactly right for a state the old run never had).
-_NEW_STATE_FIELDS = ("server_opt_state",)
+# State fields added after the first release of the wire format, OLDEST
+# FIRST. Checkpoints written before a field existed lack its key, and flax's
+# from_bytes raises on any key mismatch — so a failed decode retries with
+# progressively more of these (newest first) dropped from the template and
+# refilled from ``like`` (i.e. their freshly-initialised values, which is
+# exactly right for a state the old run never had). The suffix order handles
+# mid-generation blobs that have some but not all of the newer fields.
+_NEW_STATE_FIELDS = ("server_opt_state", "last_client_loss")
 
 
 def _legacy_decode(data: bytes, like: Pytree) -> Optional[Pytree]:
     if not hasattr(like, "_asdict"):
         return None
-    d = dict(like._asdict())
-    dropped = {k: d.pop(k) for k in _NEW_STATE_FIELDS if k in d}
-    if not dropped:
-        return None
-    try:
-        tree = wire.decode(data, d)
-    except ValueError:
-        return None
-    return type(like)(**tree, **dropped)
+    full = dict(like._asdict())
+    present = [k for k in _NEW_STATE_FIELDS if k in full]
+    for n_drop in range(1, len(present) + 1):
+        d = dict(full)
+        dropped = {k: d.pop(k) for k in present[-n_drop:]}
+        try:
+            tree = wire.decode(data, d)
+        except ValueError:
+            continue
+        return type(like)(**tree, **dropped)
+    return None
 
 
 def _scan_rounds(directory: str) -> List[int]:

@@ -146,6 +146,13 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         help="random fraction of live clients sampled each round "
         "(1.0 = all, reference behavior)",
     )
+    p.add_argument(
+        "--participation-sampling",
+        default="uniform",
+        choices=["uniform", "loss"],
+        help="how the sampled subset is drawn: uniform, or importance "
+        "sampling proportional to each client's last training loss",
+    )
 
 
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
@@ -190,6 +197,9 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             weighted=not getattr(args, "unweighted", False),
             participation_fraction=getattr(
                 args, "participation_fraction", 1.0
+            ),
+            participation_sampling=getattr(
+                args, "participation_sampling", "uniform"
             ),
         ),
         steps_per_round=steps_per_round,

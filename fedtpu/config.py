@@ -88,6 +88,11 @@ class FedConfig:
     weighted: bool = True
     # Client sampling fraction per round (1.0 == all clients, reference behavior).
     participation_fraction: float = 1.0
+    # How the sampled subset is drawn: "uniform", or "loss" — importance
+    # sampling proportional to each client's last observed training loss
+    # (clients the model serves worst get picked more often; see e.g.
+    # arXiv:2306.03240). Falls back to uniform until a loss is observed.
+    participation_sampling: str = "uniform"  # uniform | loss
     # Compression of client deltas before aggregation (parity with -c Y,
     # reference: src/server.py:104-107). none | topk | int8
     compression: str = "none"

@@ -153,6 +153,24 @@ class Federation:
         self._shuffle = shuffle
         self._img_shape = img_shape
         self._multi_steps = {}  # num_rounds -> compiled scan program
+        if cfg.fed.participation_sampling not in ("uniform", "loss"):
+            raise ValueError(
+                f"unknown participation_sampling "
+                f"{cfg.fed.participation_sampling!r}; have uniform | loss"
+            )
+        if (
+            cfg.fed.participation_sampling == "loss"
+            and jax.process_count() > 1
+        ):
+            # Each controller builds its own alive mask from its own loss
+            # observations; per-process PARTIAL observations would diverge
+            # the masks (and thus the program inputs) across controllers.
+            raise ValueError(
+                "participation_sampling='loss' is single-controller only: "
+                "per-client losses are sharded across processes and partial "
+                "observations would desynchronise the sampling masks. Use "
+                "'uniform' on multi-controller deployments."
+            )
 
     def _placed(self, x, sharded: bool):
         """Place an array for the active topology: sharded along the clients
@@ -185,15 +203,32 @@ class Federation:
     # ---------------------------------------------------------------- data
     def _alive_for_round(self, round_idx: int) -> np.ndarray:
         """This round's participation mask: heartbeat-dead clients plus
-        optional random subsampling of the live ones (the reference always
-        uses every live client)."""
+        optional subsampling of the live ones (the reference always uses
+        every live client). With ``participation_sampling='loss'`` the
+        subset is drawn with probability proportional to each client's last
+        observed training loss (importance sampling — worst-served clients
+        get picked more often); uniform until a loss has been observed, and
+        a fused block reuses the losses known before the block started."""
         alive = self.alive.copy()
         frac = self.cfg.fed.participation_fraction
         if frac < 1.0:
             rng = np.random.default_rng(self.cfg.data.seed * 7919 + round_idx)
             live = np.flatnonzero(alive)
             k = max(1, int(round(frac * len(live))))
-            keep = rng.choice(live, size=k, replace=False)
+            p = None
+            if self.cfg.fed.participation_sampling == "loss":
+                # Observations live in FederatedState (updated per round on
+                # device, NaN until first observed, checkpointed); fetched
+                # only here, when a sampling decision actually needs them.
+                obs = np.asarray(self._state.last_client_loss)[live]
+                if not np.all(np.isnan(obs)):
+                    # Never-observed clients get the optimistic fill (the
+                    # max observed loss) so they are explored, not starved.
+                    fill = float(np.nanmax(obs))
+                    w = np.where(np.isnan(obs), fill, obs)
+                    w = np.maximum(w, 0.0) + 1e-8
+                    p = w / w.sum()
+            keep = rng.choice(live, size=k, replace=False, p=p)
             alive = np.zeros_like(alive)
             alive[keep] = True
         return alive

@@ -46,6 +46,12 @@ class FederatedState(NamedTuple):
     - ``server_opt_state``: server optimizer moments over the global model
       (:mod:`fedtpu.core.server_opt`, the FedOpt family); ``()`` for plain
       FedAvg.
+    - ``last_client_loss``: ``[clients]`` f32, each client's most recent
+      observed training loss (NaN until first observed; dead/unsampled
+      clients keep their previous value). Updated inside the round step —
+      so fused scans accumulate it per ROUND on device — and checkpointed
+      with the rest of the state. Feeds loss-proportional participation
+      sampling (:class:`fedtpu.config.FedConfig`).
     """
 
     params: Pytree
@@ -55,6 +61,7 @@ class FederatedState(NamedTuple):
     round_idx: jnp.ndarray
     comp_state: Pytree = ()
     server_opt_state: Pytree = ()
+    last_client_loss: jnp.ndarray = ()
 
 
 class RoundMetrics(NamedTuple):
@@ -133,6 +140,7 @@ def init_state(
         round_idx=jnp.zeros((), jnp.int32),
         comp_state=() if compressor is None else compressor.init(params, n),
         server_opt_state=server_opt.init(cfg.fed, params),
+        last_client_loss=jnp.full((n,), jnp.nan, jnp.float32),
     )
 
 
@@ -545,6 +553,11 @@ def make_round_step(
             round_idx=state.round_idx + 1,
             comp_state=comp_state,
             server_opt_state=new_server_opt,
+            last_client_loss=jnp.where(
+                batch.alive,
+                out.loss.astype(jnp.float32),
+                state.last_client_loss,
+            ),
         )
         return new_state, metrics
 

@@ -37,6 +37,7 @@ def state_specs(axis: str) -> FederatedState:
         round_idx=P(),
         comp_state=P(axis),
         server_opt_state=P(),  # server moments act on the global model
+        last_client_loss=P(axis),
     )
 
 
@@ -119,6 +120,7 @@ def shard_state(state: FederatedState, mesh: Mesh, axis: str) -> FederatedState:
         server_opt_state=jax.tree.map(
             lambda x: put(x, P()), state.server_opt_state
         ),
+        last_client_loss=put(state.last_client_loss, P(axis)),
     )
 
 

@@ -1,0 +1,171 @@
+"""Loss-proportional client importance sampling
+(FedConfig.participation_sampling='loss').
+
+Observations live in ``FederatedState.last_client_loss`` — updated per round
+ON DEVICE (so fused scans accumulate every round, not just the block's
+last), NaN until first observed, checkpointed with the state. Never-observed
+clients sample at the optimistic fill (max observed loss), so a small
+first-round subset cannot permanently starve the rest.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core import Federation
+
+
+def _cfg(**fed_kw):
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.01, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="round_robin",
+            num_examples=160,
+        ),
+        fed=FedConfig(num_clients=5, **fed_kw),
+        steps_per_round=2,
+    )
+
+
+def test_unknown_sampling_mode_raises():
+    with pytest.raises(ValueError, match="participation_sampling"):
+        Federation(_cfg(participation_sampling="softmax"), seed=0)
+
+
+def test_state_starts_nan_and_observes_sampled_clients_only():
+    fed = Federation(
+        _cfg(participation_fraction=0.4, participation_sampling="loss"),
+        seed=0,
+    )
+    assert np.isnan(np.asarray(fed.state.last_client_loss)).all()
+    m = fed.step()
+    obs = np.asarray(fed.state.last_client_loss)
+    sampled = np.asarray(m.per_client_loss) > 0
+    assert (~np.isnan(obs[sampled])).all()
+    assert np.isnan(obs[~sampled]).all()
+
+
+def test_high_loss_client_is_sampled_more_often():
+    """Force one client's observed loss far above the rest and count picks
+    over many mask draws: it must be selected much more often than an
+    average client under loss sampling, and ~uniformly under uniform."""
+    fed = Federation(
+        _cfg(participation_fraction=0.4, participation_sampling="loss"),
+        seed=0,
+    )
+    fed.state = fed.state._replace(
+        last_client_loss=jnp.asarray([0.1, 0.1, 0.1, 0.1, 10.0], jnp.float32)
+    )
+    picks = np.zeros(5)
+    for r in range(300):
+        picks += fed._alive_for_round(1000 + r)
+    assert picks[4] > 250, picks              # hot client nearly always in
+    assert picks[:4].max() < picks[4], picks
+
+    uni = Federation(_cfg(participation_fraction=0.4), seed=0)
+    upicks = np.zeros(5)
+    for r in range(300):
+        upicks += uni._alive_for_round(1000 + r)
+    assert upicks.std() < 30, upicks          # roughly even
+
+
+def test_never_observed_clients_are_explored_not_starved():
+    """Clients with NaN observations sample at the optimistic fill (max
+    observed), so a tiny first-round subset cannot freeze out the rest."""
+    fed = Federation(
+        _cfg(participation_fraction=0.4, participation_sampling="loss"),
+        seed=0,
+    )
+    # Two clients observed at a LOW loss, three never observed.
+    fed.state = fed.state._replace(
+        last_client_loss=jnp.asarray(
+            [0.05, 0.05, np.nan, np.nan, np.nan], jnp.float32
+        )
+    )
+    picks = np.zeros(5)
+    for r in range(300):
+        picks += fed._alive_for_round(2000 + r)
+    # The unobserved majority must be picked at least as often as the
+    # observed low-loss clients.
+    assert picks[2:].min() >= picks[:2].max() * 0.8, picks
+
+
+def test_dead_client_keeps_last_observation():
+    fed = Federation(
+        _cfg(participation_fraction=0.6, participation_sampling="loss"),
+        seed=0,
+    )
+    fed.step()
+    before = np.asarray(fed.state.last_client_loss).copy()
+    fed.set_alive(2, False)
+    fed.step()
+    after = np.asarray(fed.state.last_client_loss)
+    np.testing.assert_allclose(after[2], before[2])
+
+
+def test_fused_block_accumulates_every_rounds_observations():
+    """The state updates per scan iteration, so a client sampled in ANY
+    round of the block keeps its freshest observation — not only the
+    block's final round."""
+    fed = Federation(
+        _cfg(participation_fraction=0.5, participation_sampling="loss"),
+        seed=0,
+    )
+    m = fed.run_on_device(4)
+    pcl = np.asarray(m.per_client_loss)  # [4, 5]
+    obs = np.asarray(fed.state.last_client_loss)
+    ever = (pcl > 0).any(axis=0)
+    assert (~np.isnan(obs[ever])).all()
+    # Each observed value equals that client's LAST positive round.
+    for c in np.flatnonzero(ever):
+        last = pcl[:, c][pcl[:, c] > 0][-1]
+        np.testing.assert_allclose(obs[c], last, rtol=1e-6)
+
+
+def test_observations_survive_checkpoint_roundtrip(tmp_path):
+    from fedtpu.checkpoint import Checkpointer
+
+    cfg = _cfg(participation_fraction=0.5, participation_sampling="loss")
+    fed = Federation(cfg, seed=0)
+    fed.step()
+    ckpt = Checkpointer(str(tmp_path), backend="wire")
+    ckpt.save(1, fed.state)
+    fresh = Federation(cfg, seed=1)
+    _, restored = ckpt.restore_latest(like=fresh.state)
+    a = np.asarray(fed.state.last_client_loss)
+    b = np.asarray(restored.last_client_loss)
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    np.testing.assert_allclose(a[~np.isnan(a)], b[~np.isnan(b)])
+
+
+def test_mid_generation_checkpoint_restores(tmp_path):
+    """A blob with server_opt_state but WITHOUT last_client_loss (written
+    between the two schema additions) must restore via the progressive
+    legacy fallback, refilling only the missing field."""
+    from fedtpu.checkpoint import Checkpointer, checkpoint
+    from fedtpu.transport import wire
+
+    fed = Federation(_cfg(), seed=0)
+    fed.step()
+    legacy = {
+        k: v for k, v in fed.state._asdict().items()
+        if k != "last_client_loss"
+    }
+    with open(checkpoint._wire_path(str(tmp_path), 2), "wb") as fh:
+        fh.write(wire.encode(legacy, compress=True))
+    fresh = Federation(_cfg(), seed=1)
+    rnd, restored = Checkpointer(str(tmp_path), backend="wire").restore_latest(
+        like=fresh.state
+    )
+    assert rnd == 2
+    for a, b in zip(
+        np.asarray(fed.state.params["Dense_0"]["kernel"]).ravel()[:5],
+        np.asarray(restored.params["Dense_0"]["kernel"]).ravel()[:5],
+    ):
+        np.testing.assert_allclose(a, b)
+    assert np.isnan(np.asarray(restored.last_client_loss)).all()

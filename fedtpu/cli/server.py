@@ -41,6 +41,17 @@ def main(argv=None) -> int:
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
     p.add_argument(
+        "--async-updates",
+        default=0,
+        type=int,
+        metavar="N",
+        help="run the FedBuff semi-asynchronous mode for N server updates "
+        "instead of synchronous rounds: clients train continuously, the "
+        "server aggregates every --buffer-k replies with staleness-"
+        "discounted weights (the reference has no async mode)",
+    )
+    p.add_argument("--buffer-k", default=2, type=int)
+    p.add_argument(
         "--round-deadline",
         default=None,
         type=float,
@@ -93,9 +104,16 @@ def main(argv=None) -> int:
 
         # run() (not a bare round() loop) so the heartbeat recovery thread
         # and the backup liveness pinger actually run in the CLI deployment.
-        primary.run(
-            num_rounds=cfg.fed.num_rounds - start_round, on_round=on_round
-        )
+        if args.async_updates:
+            primary.run_async(
+                num_updates=args.async_updates,
+                buffer_k=args.buffer_k,
+                on_update=on_round,
+            )
+        else:
+            primary.run(
+                num_rounds=cfg.fed.num_rounds - start_round, on_round=on_round
+            )
         return 0
 
     backup = BackupServer(

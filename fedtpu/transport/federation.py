@@ -757,6 +757,218 @@ class PrimaryServer:
         self.history.append(rec)
         return rec
 
+    # -------------------------------------------------------- async (FedBuff)
+    def run_async(
+        self,
+        num_updates: int,
+        buffer_k: int = 2,
+        staleness_power: float = 0.5,
+        stop: Optional[Callable[[], bool]] = None,
+        on_update: Optional[Callable[[int, dict], None]] = None,
+    ) -> List[dict]:
+        """Semi-asynchronous orchestration (FedBuff, Nguyen et al. 2022).
+
+        Instead of the synchronous round barrier, every live client loops
+        independently: receive the current global model, train, reply. The
+        server buffers incoming deltas and applies an aggregation as soon as
+        ``buffer_k`` have arrived, weighting each by
+        ``num_examples / (1 + staleness)**staleness_power`` where staleness
+        is how many server updates landed since that client's base model.
+        Fast clients contribute often; a slow client's (stale) delta still
+        counts, just discounted — no one blocks anyone.
+
+        The reference has no async mode at all (its barrier is
+        ``src/server.py:132-135``); this composes with the plain mean
+        aggregator + server optimizer only: compression (sparse deltas
+        against stale baselines), robust aggregators (buffer_k is too small
+        a population), and DP (per-update participation accounting differs)
+        are rejected.
+
+        Returns per-update records; runs until ``num_updates`` aggregations
+        (or ``stop()``).
+        """
+        import queue
+
+        fed = self.cfg.fed
+        if fed.compression != "none":
+            raise ValueError(
+                "run_async requires compression='none': sparse deltas "
+                "against stale baselines corrupt aggregation."
+            )
+        if fed.aggregator != "mean":
+            raise ValueError(
+                "run_async requires aggregator='mean': a buffer of "
+                f"{buffer_k} is too small a population for robust statistics."
+            )
+        if fed.dp_clip_norm > 0:
+            raise ValueError(
+                "run_async does not support DP: per-update participation "
+                "accounting differs from the synchronous analysis."
+            )
+        if buffer_k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
+
+        replies: "queue.Queue" = queue.Queue()
+        done = threading.Event()
+        version_lock = threading.Lock()
+        self._async_version = 0
+
+        def snapshot():
+            """(version, payload, host base) for the CURRENT global model —
+            computed ONCE per version (a full encode + device->host copy per
+            worker iteration would serialize everyone on version_lock)."""
+            return (
+                self._async_version,
+                self.model_bytes(),
+                {
+                    "params": jax.tree.map(np.asarray, self.params),
+                    "batch_stats": jax.tree.map(np.asarray, self.batch_stats),
+                },
+            )
+
+        current = [snapshot()]  # guarded by version_lock
+
+        def worker(client: str, rank: int) -> None:
+            """One client's loop: sync -> train -> enqueue, until done."""
+            while not done.is_set():
+                if not self.registry.is_alive(client):
+                    time.sleep(0.2)  # heartbeat monitor may revive it
+                    continue
+                try:
+                    with version_lock:
+                        base_version, payload, base = current[0]
+                    self._stubs[client].SendModel(
+                        proto.SendModelRequest(model=payload),
+                        timeout=self.rpc_timeout,
+                    )
+                    reply = self._stubs[client].StartTrain(
+                        proto.TrainRequest(
+                            # Each client keeps its OWN registry-order shard
+                            # (the synchronous path assigns ranks the same
+                            # way, src/server.py:126-129).
+                            rank=rank, world=len(self.registry.clients)
+                        ),
+                        timeout=self.rpc_timeout,
+                    )
+                    tree = wire.decode(
+                        reply.message, _payload_template(self.model, self.cfg)
+                    )
+                    delta = jax.tree.map(
+                        lambda a, g: np.asarray(a) - g,
+                        {"params": tree["params"],
+                         "batch_stats": tree["batch_stats"]},
+                        base,
+                    )
+                    replies.put(
+                        (client, delta, float(tree["num_examples"]),
+                         base_version)
+                    )
+                except grpc.RpcError as e:
+                    log.warning(
+                        "async client %s failed: %s %s",
+                        client, e.code(), e.details(),
+                    )
+                    self.registry.mark_failed(client)
+
+        self.monitor.start()
+        if self.pinger is not None:
+            self.pinger.tick()
+            self.pinger.start()
+        workers = [
+            threading.Thread(target=worker, args=(c, rank), daemon=True)
+            for rank, c in enumerate(self.registry.clients)
+        ]
+        for w in workers:
+            w.start()
+        all_dead_since: List[Optional[float]] = [None]
+
+        def hopeless() -> bool:
+            """True when no reply can plausibly ever arrive again: every
+            client dead (workers sleep-loop awaiting heartbeat revival, so
+            thread liveness can't signal this), nothing buffered, and the
+            state has persisted past several heartbeat cycles."""
+            if self.registry.active_clients() or not replies.empty():
+                all_dead_since[0] = None
+                return False
+            if all_dead_since[0] is None:
+                all_dead_since[0] = time.monotonic()
+            return time.monotonic() - all_dead_since[0] > 10.0
+
+        try:
+            while self._async_version < num_updates:
+                if stop is not None and stop():
+                    break
+                buf = []
+                while len(buf) < buffer_k:
+                    try:
+                        buf.append(replies.get(timeout=1.0))
+                    except queue.Empty:
+                        if (stop is not None and stop()) or hopeless():
+                            break
+                if len(buf) < buffer_k:
+                    if hopeless():
+                        log.warning("all async clients dead; stopping")
+                        break
+                    continue
+                with version_lock:
+                    v = self._async_version
+                    stalenesses = [v - b for _, _, _, b in buf]
+                    weights = jnp.asarray(
+                        [
+                            (n if fed.weighted else 1.0)
+                            / (1.0 + s) ** staleness_power
+                            for (_, _, n, _), s in zip(buf, stalenesses)
+                        ],
+                        jnp.float32,
+                    )
+                    stacked = jax.tree.map(
+                        lambda *leaves: jnp.stack(leaves),
+                        *[d for _, d, _, _ in buf],
+                    )
+                    new_global, self._server_opt_state = self._aggregate(
+                        {"params": self.params,
+                         "batch_stats": self.batch_stats},
+                        stacked,
+                        weights,
+                        self._server_opt_state,
+                        jnp.asarray(v, jnp.int32),
+                    )
+                    self.params = new_global["params"]
+                    self.batch_stats = new_global["batch_stats"]
+                    self._async_version = v + 1
+                    current[0] = snapshot()
+                if self.backup_stub is not None:
+                    try:
+                        self.backup_stub.SendModel(
+                            proto.SendModelRequest(model=self.replica_bytes()),
+                            timeout=self.rpc_timeout,
+                        )
+                    except grpc.RpcError:
+                        log.warning("backup unreachable during replication")
+                rec = {
+                    "update": self._async_version,
+                    "contributors": [c for c, _, _, _ in buf],
+                    "staleness": stalenesses,
+                    "alive": self.registry.alive_mask().tolist(),
+                }
+                self.history.append(rec)
+                log.info("async update %s", rec)
+                if on_update is not None:
+                    on_update(self._async_version, rec)
+            # Deliver the FINAL model: workers stop syncing once done is
+            # set, and without this every client would end at least one
+            # update stale (the synchronous path broadcasts every round).
+            done.set()
+            for w in workers:
+                w.join(timeout=self.rpc_timeout)
+            self.sync_clients()
+        finally:
+            done.set()
+            self.monitor.stop()
+            if self.pinger is not None:
+                self.pinger.stop()
+        return self.history
+
     def run(
         self,
         num_rounds: Optional[int] = None,

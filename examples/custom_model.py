@@ -32,6 +32,12 @@ class TinyNet(nn.Module):
 
 
 def main():
+    if "--tpu" not in sys.argv:
+        # CPU by default: a wedged remote TPU backend would otherwise hang
+        # this demo at the first device query.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     cfg = RoundConfig(
         model="tinynet",
         num_classes=10,

@@ -20,7 +20,18 @@ from fedtpu.data import load
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="pin the jax platform (--smoke implies cpu); without a pin a "
+        "wedged remote TPU backend can hang the process",
+    )
     args = p.parse_args()
+    if args.platform or args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform or "cpu")
 
     cfg = RoundConfig(
         model="smallcnn" if args.smoke else "MobileNet",

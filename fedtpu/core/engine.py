@@ -63,6 +63,26 @@ class Federation:
         (one chip, or tests)."""
         self.cfg = cfg
         self.mesh = mesh
+        # Config validation FIRST — a bad flag must not cost a model build,
+        # a dataset load, and jit construction before raising.
+        if cfg.fed.participation_sampling not in ("uniform", "loss"):
+            raise ValueError(
+                f"unknown participation_sampling "
+                f"{cfg.fed.participation_sampling!r}; have uniform | loss"
+            )
+        if (
+            cfg.fed.participation_sampling == "loss"
+            and jax.process_count() > 1
+        ):
+            # Each controller builds its own alive mask from its own loss
+            # observations; per-process PARTIAL observations would diverge
+            # the masks (and thus the program inputs) across controllers.
+            raise ValueError(
+                "participation_sampling='loss' is single-controller only: "
+                "per-client losses are sharded across processes and partial "
+                "observations would desynchronise the sampling masks. Use "
+                "'uniform' on multi-controller deployments."
+            )
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -153,24 +173,6 @@ class Federation:
         self._shuffle = shuffle
         self._img_shape = img_shape
         self._multi_steps = {}  # num_rounds -> compiled scan program
-        if cfg.fed.participation_sampling not in ("uniform", "loss"):
-            raise ValueError(
-                f"unknown participation_sampling "
-                f"{cfg.fed.participation_sampling!r}; have uniform | loss"
-            )
-        if (
-            cfg.fed.participation_sampling == "loss"
-            and jax.process_count() > 1
-        ):
-            # Each controller builds its own alive mask from its own loss
-            # observations; per-process PARTIAL observations would diverge
-            # the masks (and thus the program inputs) across controllers.
-            raise ValueError(
-                "participation_sampling='loss' is single-controller only: "
-                "per-client losses are sharded across processes and partial "
-                "observations would desynchronise the sampling masks. Use "
-                "'uniform' on multi-controller deployments."
-            )
 
     def _placed(self, x, sharded: bool):
         """Place an array for the active topology: sharded along the clients

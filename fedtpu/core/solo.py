@@ -62,6 +62,12 @@ class SoloTrainer:
         trajectory (running stats here are the pmean over shards).
         Dropout/augmentation RNG is fold_in-decorrelated per shard."""
         self.cfg = cfg
+        if mesh is not None and cfg.data.batch_size % mesh.devices.size:
+            # Validate before the model build / dataset load below.
+            raise ValueError(
+                f"batch_size={cfg.data.batch_size} not divisible by "
+                f"mesh size {mesh.devices.size}"
+            )
         self.model = model_zoo.create(
             cfg.model, num_classes=cfg.num_classes, remat=cfg.remat
         )
@@ -85,11 +91,6 @@ class SoloTrainer:
         else:
             from jax.sharding import PartitionSpec as P
 
-            if cfg.data.batch_size % mesh.devices.size:
-                raise ValueError(
-                    f"batch_size={cfg.data.batch_size} not divisible by "
-                    f"mesh size {mesh.devices.size}"
-                )
             axis = mesh.axis_names[0]
             body = self._make_train_step(axis_name=axis)
             self._train_step = jax.jit(

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Background TPU-window watcher for the wedge-prone tunnel backend.
+
+The axon tunnel to the one real TPU chip wedges for hours at a time and
+recovers unpredictably (round-3 observation: one ~20-minute live window in a
+~12 h session). This watcher makes sure a live window is never wasted:
+
+  * It probes tunnel health at a modest cadence with a bounded tiny-matmul
+    child process (a wedged tunnel hangs ANY device query, so everything runs
+    in subprocesses with hard timeouts — the watcher itself can never hang).
+  * Long quiet periods between probes: repeatedly killing clients mid-init
+    appears to prolong the wedge, so the default cadence is 20 min of total
+    silence between probes.
+  * On the FIRST healthy probe it immediately runs the job queue, serialized
+    (never two TPU processes at once, guarded by an exclusive flock):
+      1. ``bench.py``              -> artifacts/BENCH_LIVE_r04.json
+      2. ``tools/run_pallas_tpu.py``  -> artifacts/PALLAS_TPU_RUN.json
+      3. ``tools/bench_profile_tpu.py`` (if present) -> MFU profile artifacts
+    Jobs that succeed are recorded in a state file so a restarted watcher (or
+    a later window after a partial capture) only runs what is still missing.
+  * All artifacts are written atomically (tmp + os.replace); every action is
+    appended to a timestamped log that is itself the round's evidence that
+    the watcher ran (VERDICT r3, "Next round" #2).
+
+Exit: 0 once every job has succeeded, 4 on deadline with jobs still pending.
+
+Usage::
+
+    nohup python tools/tpu_watch.py --max-hours 11 >> artifacts/tpu_watch_r04.log 2>&1 &
+"""
+
+import argparse
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+LOCK_PATH = os.path.join(REPO, ".tpu_access.lock")
+STATE_PATH = os.path.join(ART, "tpu_watch_state.json")
+
+_PROBE_CHILD = """
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((256, 256))
+import numpy as np
+print(d.device_kind, "|", float(np.asarray(x @ x).sum()))
+"""
+
+
+def log(msg):
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(f"[tpu_watch {ts}] {msg}", flush=True)
+
+
+def atomic_write(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def load_state():
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"done": [], "history": []}
+
+
+def save_state(state):
+    atomic_write(STATE_PATH, json.dumps(state, indent=2))
+
+
+def probe(timeout_s):
+    """(healthy, detail) — tiny on-device matmul in a bounded child."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CHILD],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout {timeout_s}s (wedged)"
+    if proc.returncode != 0:
+        return False, f"probe rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
+    return True, proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "ok"
+
+
+def _bench_job():
+    """Run bench.py; success = a JSON line with value > 0, saved as the live
+    artifact (bench.py itself is already subprocess-isolated + bounded)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=3600,
+    )
+    line = None
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        cand = cand.strip()
+        if cand.startswith("{"):
+            try:
+                obj = json.loads(cand)
+            except ValueError:
+                continue
+            line = obj
+            break
+    if not line:
+        return False, f"no JSON from bench.py (rc={proc.returncode})"
+    if line.get("value", 0) <= 0:
+        return False, f"bench diagnostic: {line.get('error', line)}"
+    line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    line["captured_by"] = "tools/tpu_watch.py (round 4 watcher)"
+    atomic_write(os.path.join(ART, "BENCH_LIVE_r04.json"), json.dumps(line, indent=2))
+    return True, f"value={line['value']} {line.get('unit', '')} mfu={line.get('mfu')}"
+
+
+def _script_job(rel, timeout_s, artifact):
+    def run():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, rel)],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+        ok = proc.returncode == 0 and os.path.exists(os.path.join(ART, artifact))
+        tail = (proc.stderr or proc.stdout).strip()[-300:]
+        return ok, f"rc={proc.returncode} {tail}" if not ok else f"wrote {artifact}"
+    return run
+
+
+JOBS = [
+    ("bench_fused", _bench_job),
+    ("pallas_timing", _script_job("tools/run_pallas_tpu.py", 2400, "PALLAS_TPU_RUN.json")),
+    ("mfu_profile", _script_job("tools/bench_profile_tpu.py", 2400, "MFU_PROFILE_r04.json")),
+]
+
+
+def run_pending(state, lock_file):
+    """Run every not-yet-done job, serialized under the exclusive lock."""
+    fcntl.flock(lock_file, fcntl.LOCK_EX)
+    try:
+        for name, job in JOBS:
+            if name in state["done"]:
+                continue
+            path = os.path.join(REPO, "tools", "bench_profile_tpu.py")
+            if name == "mfu_profile" and not os.path.exists(path):
+                log(f"job {name}: script not present yet, skipping this window")
+                continue
+            log(f"job {name}: starting")
+            t0 = time.time()
+            try:
+                ok, detail = job()
+            except subprocess.TimeoutExpired:
+                ok, detail = False, "job timeout (tunnel likely re-wedged)"
+            except Exception as exc:  # noqa: BLE001 - watcher must survive anything
+                ok, detail = False, f"exception: {exc!r}"
+            dt = round(time.time() - t0, 1)
+            log(f"job {name}: {'OK' if ok else 'FAILED'} in {dt}s — {detail}")
+            state["history"].append(
+                {"job": name, "ok": ok, "detail": detail, "secs": dt,
+                 "at": time.strftime("%Y-%m-%dT%H:%M:%S")})
+            if ok:
+                state["done"].append(name)
+            save_state(state)
+            if not ok:
+                # Tunnel likely dropped mid-job — stop burning it; re-probe later.
+                return False
+        return True
+    finally:
+        fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval-s", type=float, default=1200.0,
+                   help="quiet seconds between probes (default 20 min)")
+    p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument("--max-hours", type=float, default=11.0)
+    p.add_argument("--once", action="store_true", help="single probe+run, no loop")
+    args = p.parse_args()
+
+    os.makedirs(ART, exist_ok=True)
+    state = load_state()
+    deadline = time.time() + args.max_hours * 3600
+    lock_file = open(LOCK_PATH, "w")
+
+    required = {n for n, _ in JOBS}
+    log(f"watcher start: jobs done={state['done']}, interval={args.interval_s}s, "
+        f"max_hours={args.max_hours}")
+    while time.time() < deadline:
+        if required <= set(state["done"]):
+            log("all jobs captured — exiting, leaving the tunnel quiet")
+            return 0
+        healthy, detail = probe(args.probe_timeout)
+        log(f"probe: {'LIVE' if healthy else 'down'} — {detail}")
+        state["history"].append(
+            {"probe": healthy, "detail": detail,
+             "at": time.strftime("%Y-%m-%dT%H:%M:%S")})
+        save_state(state)
+        if healthy:
+            all_done = run_pending(state, lock_file)
+            if all_done and required <= set(state["done"]):
+                log("all jobs captured — exiting")
+                return 0
+        if args.once:
+            break
+        time.sleep(args.interval_s)
+    pending = sorted(required - set(state["done"]))
+    log(f"deadline reached; pending jobs: {pending}")
+    return 4 if pending else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

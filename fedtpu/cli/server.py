@@ -84,23 +84,39 @@ def main(argv=None) -> int:
         if args.checkpoint_dir:
             ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
             if args.resume:
-                params, stats = _model_template(primary.model, cfg)
-                latest = ckpt.restore_latest(
-                    {"params": params, "batch_stats": stats}
-                )
+                # Full server state (model + round counter + FedOpt
+                # moments); legacy model-only checkpoints still restore,
+                # with the counter estimated from the checkpoint index.
+                try:
+                    latest = ckpt.restore_latest(primary.state_template())
+                except ValueError:
+                    params, stats = _model_template(primary.model, cfg)
+                    legacy = ckpt.restore_latest(
+                        {"params": params, "batch_stats": stats}
+                    )
+                    latest = None
+                    if legacy is not None:
+                        r, tree = legacy
+                        primary.params = jax.tree.map(
+                            jnp.asarray, tree["params"]
+                        )
+                        primary.batch_stats = jax.tree.map(
+                            jnp.asarray, tree["batch_stats"]
+                        )
+                        primary._round_counter = r + 1
+                        start_round = r + 1
+                        logging.info(
+                            "resumed legacy model-only checkpoint from "
+                            "round %d", r,
+                        )
                 if latest is not None:
                     r, tree = latest
-                    primary.params = jax.tree.map(jnp.asarray, tree["params"])
-                    primary.batch_stats = jax.tree.map(
-                        jnp.asarray, tree["batch_stats"]
-                    )
+                    primary.install_state(tree)
                     start_round = r + 1
                     logging.info("resumed global model from round %d", r)
         def on_round(r: int, rec: dict) -> None:
             if ckpt is not None:
-                ckpt.save(start_round + r,
-                          {"params": primary.params,
-                           "batch_stats": primary.batch_stats})
+                ckpt.save(start_round + r, primary.state_tree())
 
         # run() (not a bare round() loop) so the heartbeat recovery thread
         # and the backup liveness pinger actually run in the CLI deployment.

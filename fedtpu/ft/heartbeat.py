@@ -53,10 +53,14 @@ class ClientRegistry:
             return [c for c in self._order if not self._alive[c]]
 
     def active_clients(self) -> List[str]:
-        """Clients that would receive ranks this round. Rank assignment
-        follows the reference: ranks are indices among *active* clients in
-        registry order, while ``world`` stays the total client count
-        (``src/server.py:126-129``)."""
+        """Clients that participate this round, in registry order. Each
+        client's rank (data shard) is its stable REGISTRY index — a
+        deliberate divergence from the reference, which renumbers ranks
+        among the currently-active clients every round
+        (``src/server.py:126-129``) and therefore silently moves a client's
+        shard whenever any peer dies. Stable ranks match the simulated
+        engine's alive-mask semantics; ``world`` stays the total client
+        count in both designs."""
         with self._lock:
             return [c for c in self._order if self._alive[c]]
 

@@ -346,6 +346,13 @@ class PrimaryServer:
                 )
         self._server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
         self._server_opt_state = server_opt_lib.init(cfg.fed, self.params)
+        # Monotonic count of aggregations performed across this model
+        # lineage's *entire* life — seeds DP noise and participation
+        # subsampling, rides in the replica payload, and is restored by
+        # _install so a promoted backup (or recovering primary) never
+        # replays earlier rounds' PRNG draws. len(self.history) cannot
+        # serve: history restarts at 0 in every new server process.
+        self._round_counter = 0
         if initial_model is not None:
             self._install(initial_model)
 
@@ -372,6 +379,10 @@ class PrimaryServer:
         # Straggler StartTrain threads still in flight from earlier rounds,
         # keyed by client (see round()).
         self._inflight: Dict[str, threading.Thread] = {}
+        # Broadcast SendModel threads still in flight from earlier rounds —
+        # tracked like _inflight so next round's send to the same client
+        # cannot race a stale one and install an older model last.
+        self._sends: Dict[str, threading.Thread] = {}
 
     # ----------------------------------------------------------- aggregation
     def _aggregate_impl(
@@ -460,44 +471,105 @@ class PrimaryServer:
             compress=self.compress,
         )
 
+    def state_tree(self) -> dict:
+        """Full resumable server state as one pytree: the model, the
+        monotonic round counter, and (when a server optimizer is configured)
+        its moments. This is both the replica payload body and the
+        checkpoint state — one format, so failover and resume can never
+        drift apart."""
+        tree = {
+            "params": self.params,
+            "batch_stats": self.batch_stats,
+            "round_counter": np.asarray(self._round_counter, np.int64),
+        }
+        if self._server_opt is not None:
+            tree["server_opt"] = self._server_opt_state
+        return tree
+
+    def state_template(self) -> dict:
+        """Decode template matching :meth:`state_tree`'s structure."""
+        from fedtpu.core import server_opt as server_opt_lib
+
+        params, stats = _model_template(self.model, self.cfg)
+        tree = {
+            "params": params,
+            "batch_stats": stats,
+            "round_counter": np.zeros((), np.int64),
+        }
+        if self._server_opt is not None:
+            tree["server_opt"] = server_opt_lib.init(self.cfg.fed, params)
+        return tree
+
+    def install_state(self, tree: dict) -> None:
+        """Adopt a restored :meth:`state_tree` (from replica or checkpoint)."""
+        self._round_counter = int(tree["round_counter"])
+        if self._server_opt is not None:
+            self._server_opt_state = jax.tree.map(
+                jnp.asarray, tree["server_opt"]
+            )
+        self.params = jax.tree.map(jnp.asarray, tree["params"])
+        self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+
     def replica_bytes(self) -> bytes:
         """Backup-replication payload: the model plus (when a server
         optimizer is configured) its moments, so a promotion or a recovering
         primary resumes the FedOpt trajectory instead of applying stale/zero
-        moments to a model they were never computed against."""
-        tree = {"params": self.params, "batch_stats": self.batch_stats}
-        if self._server_opt is not None:
-            tree["server_opt"] = self._server_opt_state
-        return wire.encode(tree, compress=self.compress)
+        moments to a model they were never computed against. Also carries
+        the monotonic round counter so a promoted backup continues the DP
+        noise / participation-subsampling PRNG sequence instead of replaying
+        round 0's draws (which would let an observer difference two releases
+        and cancel the noise). The frame is stamped kind="replica"."""
+        return wire.encode(self.state_tree(), compress=self.compress,
+                           kind="replica")
 
     def _install(self, data: bytes) -> None:
-        """Install a replica payload (or a plain model payload — e.g. one
-        replicated by a server generation with server_optimizer=none)."""
-        from fedtpu.core import server_opt as server_opt_lib
-
-        params, stats = _model_template(self.model, self.cfg)
-        template = {"params": params, "batch_stats": stats}
-        tree = None
-        if self._server_opt is not None:
-            full = dict(
-                template,
-                server_opt=server_opt_lib.init(self.cfg.fed, params),
-            )
+        """Install a replica payload or a plain model payload, dispatched on
+        the frame's explicit payload-kind flag (never by trying templates
+        and catching exceptions): a corrupted or config-mismatched replica
+        raises instead of silently downgrading to "model-only, keep current
+        moments"."""
+        if wire.payload_kind(data) == "replica":
             try:
-                tree = wire.decode(data, full)
-                self._server_opt_state = jax.tree.map(
-                    jnp.asarray, tree["server_opt"]
+                tree = wire.decode(data, self.state_template())
+            except wire.WireError:
+                raise
+            except ValueError as exc:
+                raise wire.WireError(
+                    "replica payload does not match this server's "
+                    f"configuration ({exc}); refusing to install a partial "
+                    "state"
+                ) from exc
+            self.install_state(tree)
+        else:
+            params, stats = _model_template(self.model, self.cfg)
+            try:
+                tree = wire.decode(
+                    data, {"params": params, "batch_stats": stats}
                 )
-            except ValueError:
-                tree = None  # model-only payload; keep current moments
-        if tree is None:
-            tree = wire.decode(data, template)
-        self.params = jax.tree.map(jnp.asarray, tree["params"])
-        self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+            except wire.WireError:
+                raise
+            except ValueError as exc:
+                raise wire.WireError(
+                    "model payload does not match this server's "
+                    f"configuration ({exc})"
+                ) from exc
+            self.params = jax.tree.map(jnp.asarray, tree["params"])
+            self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
 
     def _resync(self, client: str) -> None:
         """Push the current global model to a recovered client (parity:
-        ``sendOptimizedModel`` from the recovery loop, ``src/server.py:95-99``)."""
+        ``sendOptimizedModel`` from the recovery loop, ``src/server.py:95-99``).
+
+        Raises (deferring the revive to the next heartbeat tick) while a
+        stale broadcast send to this client is still in flight — a resync
+        racing it could land first and leave the OLDER payload installed
+        last, silently desyncing the client the moment it is revived."""
+        stale = self._sends.get(client)
+        if stale is not None and stale.is_alive():
+            raise RuntimeError(
+                f"stale broadcast to {client} still in flight; "
+                "deferring resync"
+            )
         self._stubs[client].SendModel(
             proto.SendModelRequest(model=self.model_bytes()),
             timeout=self.rpc_timeout,
@@ -555,8 +627,11 @@ class PrimaryServer:
         # this round's StartTrain but still receive the broadcast.
         frac = cfg.fed.participation_fraction
         if frac < 1.0 and active:
+            # Seeded from the lineage-wide round counter (not len(history),
+            # which restarts at 0 after failover and would re-correlate the
+            # subsampling draws across server generations).
             rng = np.random.default_rng(
-                cfg.data.seed * 7919 + len(self.history)
+                cfg.data.seed * 7919 + self._round_counter
             )
             k = max(1, int(round(frac * len(active))))
             active = sorted(
@@ -638,22 +713,55 @@ class PrimaryServer:
         ]
         if still_busy:
             log.warning("stragglers still in flight, skipping: %s", still_busy)
-        launch = [c for c in active if c not in still_busy]
+        # In sparse-delta mode a client whose LAST broadcast is still in
+        # flight has a stale baseline: its top-k delta (and error-feedback
+        # residual) would be computed against a model the server has since
+        # replaced, silently corrupting aggregation (the hazard
+        # sync_clients' docstring warns about). It sits training out until
+        # its send drains. Dense mode keeps training: full weights are
+        # delta'd against the CURRENT global server-side, so a stale base
+        # is ordinary bounded staleness, not corruption.
+        unsynced = []
+        if cfg.fed.compression != "none":
+            unsynced = [
+                c for c in active
+                if c not in still_busy
+                and c in self._sends and self._sends[c].is_alive()
+            ]
+            if unsynced:
+                log.warning(
+                    "sparse mode: broadcast still in flight, baselines "
+                    "stale, sitting out: %s", unsynced,
+                )
+        launch = [
+            c for c in active if c not in still_busy and c not in unsynced
+        ]
+        # Each client trains its OWN registry-order shard, regardless of
+        # which clients were sampled or skipped this round: rank is the
+        # client's stable registry index, not its position in the launch
+        # list. Positional ranks would retrain shards 0..k-1 every round
+        # under participation sampling (shards k.. never trained) and move
+        # a client's shard between rounds — breaking engine parity (the
+        # engine's alive-mask semantics) and run_async, which already
+        # assigns registry-order ranks.
+        rank_of = {c: i for i, c in enumerate(self.registry.clients)}
         threads = {
-            client: threading.Thread(target=train_one, args=(rank, client))
-            for rank, client in enumerate(launch)
+            client: threading.Thread(
+                target=train_one, args=(rank_of[client], client)
+            )
+            for client in launch
         }
         for t in threads.values():
             t.start()
         if self.round_deadline_s is None:
             for t in threads.values():
                 t.join()
-            stragglers = list(still_busy)
+            stragglers = still_busy + unsynced
         else:
             deadline = time.monotonic() + self.round_deadline_s
             for t in threads.values():
                 t.join(max(0.0, deadline - time.monotonic()))
-            stragglers = still_busy + [
+            stragglers = still_busy + unsynced + [
                 c for c, t in threads.items() if t.is_alive()
             ]
             if stragglers:
@@ -661,8 +769,14 @@ class PrimaryServer:
                     "round deadline %.1fs hit; aggregating without %s",
                     self.round_deadline_s, stragglers,
                 )
+        # Merge this round's threads over the surviving prior entries: a
+        # straggler launched two rounds ago can still be running even though
+        # it was never in THIS round's `threads` — dropping it would hand
+        # the client a second concurrent StartTrain next round.
         self._inflight = {
-            c: t for c, t in threads.items() if t.is_alive()
+            c: t
+            for c, t in {**self._inflight, **threads}.items()
+            if t.is_alive()
         }
 
         # Snapshot completed replies under a NEW name: train_one writes to
@@ -691,10 +805,14 @@ class PrimaryServer:
                 stacked,
                 weights,
                 self._server_opt_state,
-                jnp.asarray(len(self.history), jnp.int32),
+                jnp.asarray(self._round_counter, jnp.int32),
             )
             self.params = new_global["params"]
             self.batch_stats = new_global["batch_stats"]
+        # Advance the lineage counter BEFORE replication: the replica must
+        # carry the next round's index, or a promoted backup would redraw
+        # this round's DP noise key against a different aggregate.
+        self._round_counter += 1
 
         payload = self.model_bytes()
         bytes_down = [0]  # only successful sends count
@@ -725,14 +843,28 @@ class PrimaryServer:
                 )
                 self.registry.mark_failed(client)
 
-        send_threads = [
-            threading.Thread(target=send_one, args=(c,))
-            for c in self.registry.active_clients()
+        # A client whose PREVIOUS round's broadcast is still in flight sits
+        # this broadcast out: two concurrent SendModels to one client can
+        # land out of order and install the older model last, silently
+        # desyncing it for a round. (Mirrors the _inflight guard for
+        # StartTrain.) The skipped client catches up next round — same
+        # at-most-one-round-stale guarantee a straggler already has.
+        send_busy = [
+            c for c in self.registry.active_clients()
+            if c in self._sends and self._sends[c].is_alive()
         ]
-        for t in send_threads:
+        if send_busy:
+            log.warning("previous broadcast still in flight, skipping: %s",
+                        send_busy)
+        send_threads = {
+            c: threading.Thread(target=send_one, args=(c,))
+            for c in self.registry.active_clients()
+            if c not in send_busy
+        }
+        for t in send_threads.values():
             t.start()
         if self.round_deadline_s is None:
-            for t in send_threads:
+            for t in send_threads.values():
                 t.join()
         else:
             # The broadcast gets its own deadline budget too — an overloaded
@@ -740,8 +872,13 @@ class PrimaryServer:
             # blocking-on-slowest behavior the flag removes. A send still in
             # flight simply keeps running; RpcError marks failure as usual.
             deadline = time.monotonic() + self.round_deadline_s
-            for t in send_threads:
+            for t in send_threads.values():
                 t.join(max(0.0, deadline - time.monotonic()))
+        self._sends = {
+            c: t
+            for c, t in {**self._sends, **send_threads}.items()
+            if t.is_alive()
+        }
 
         rec = {
             "participants": len(completed),
@@ -843,9 +980,9 @@ class PrimaryServer:
                     )
                     reply = self._stubs[client].StartTrain(
                         proto.TrainRequest(
-                            # Each client keeps its OWN registry-order shard
-                            # (the synchronous path assigns ranks the same
-                            # way, src/server.py:126-129).
+                            # Each client keeps its OWN registry-order shard;
+                            # the synchronous path assigns the same stable
+                            # ranks (see round()'s rank_of).
                             rank=rank, world=len(self.registry.clients)
                         ),
                         timeout=self.rpc_timeout,
@@ -936,6 +1073,10 @@ class PrimaryServer:
                     self.params = new_global["params"]
                     self.batch_stats = new_global["batch_stats"]
                     self._async_version = v + 1
+                    # Keep the lineage counter monotone across modes so a
+                    # backup promoted from async replicas (which runs the
+                    # synchronous loop) continues the PRNG sequence.
+                    self._round_counter += 1
                     current[0] = snapshot()
                 if self.backup_stub is not None:
                     try:
@@ -1071,13 +1212,29 @@ class BackupServer(TrainerServicer):
         self._stop_acting()
         stop_event = threading.Event()
         self._acting_stop = stop_event
-        acting = PrimaryServer(
-            self.cfg,
-            self.clients,
-            compress=self.compress,
-            initial_model=self.latest_model,
-            round_deadline_s=self.round_deadline_s,
-        )
+        try:
+            acting = PrimaryServer(
+                self.cfg,
+                self.clients,
+                compress=self.compress,
+                initial_model=self.latest_model,
+                round_deadline_s=self.round_deadline_s,
+            )
+        except wire.WireError:
+            # A corrupted replica must fail loudly — but not by silently
+            # killing the watchdog thread and leaving the federation with NO
+            # primary at all. Promote with a fresh model: degraded (the
+            # trajectory restarts) but live, and the log says exactly why.
+            log.exception(
+                "replicated model is corrupted or config-mismatched; "
+                "promoting with a freshly initialised model"
+            )
+            acting = PrimaryServer(
+                self.cfg,
+                self.clients,
+                compress=self.compress,
+                round_deadline_s=self.round_deadline_s,
+            )
         self.acting = acting
 
         def run_acting():

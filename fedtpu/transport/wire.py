@@ -13,6 +13,13 @@ measurable form of the reference's transport-gzip ``-c Y`` switch
 (``src/server.py:104-107``). The CRC covers the (possibly compressed)
 payload so corrupted replication streams fail loudly instead of averaging
 garbage into the global model.
+
+``flags`` bit 1 marks the payload KIND: set = backup-replica payload
+(model + server-optimizer moments + round counter), clear = plain model
+payload. The receiver selects its decode template from this flag instead of
+guessing by trying templates and catching exceptions — a corrupted or
+config-mismatched replica therefore fails loudly rather than silently
+downgrading to "model-only, drop the moments".
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ Pytree = Any
 _MAGIC = b"FTP1"
 _VERSION = 1
 _FLAG_ZLIB = 1
+_FLAG_REPLICA = 2
 _HEADER = struct.Struct("<4sBBI")
 
 
@@ -37,20 +45,37 @@ class WireError(ValueError):
     """Malformed or corrupted payload."""
 
 
-def encode(tree: Pytree, compress: bool = False, level: int = 6) -> bytes:
+def encode(
+    tree: Pytree, compress: bool = False, level: int = 6, kind: str = "model"
+) -> bytes:
     """Serialize a pytree of arrays to framed bytes.
+
+    ``kind`` is stamped into the frame flags (``"model"`` or ``"replica"``)
+    so the receiver can pick the matching decode template explicitly.
 
     Device arrays are fetched to host first (one transfer per leaf); for the
     intra-pod path this function is never called — arrays stay in HBM.
     """
+    if kind not in ("model", "replica"):
+        raise ValueError(f"unknown payload kind {kind!r}")
     host = jax.tree.map(np.asarray, tree)
     payload = serialization.to_bytes(host)
-    flags = 0
+    flags = _FLAG_REPLICA if kind == "replica" else 0
     if compress:
         payload = zlib.compress(payload, level)
         flags |= _FLAG_ZLIB
     header = _HEADER.pack(_MAGIC, _VERSION, flags, zlib.crc32(payload) & 0xFFFFFFFF)
     return header + payload
+
+
+def payload_kind(data: bytes) -> str:
+    """``"model"`` or ``"replica"`` from the frame flags (header-validated)."""
+    if len(data) < _HEADER.size or data[:4] != _MAGIC:
+        raise WireError("not a fedtpu wire payload")
+    _, version, flags, _ = _HEADER.unpack_from(data)
+    if version != _VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    return "replica" if flags & _FLAG_REPLICA else "model"
 
 
 def decode(data: bytes, like: Pytree) -> Pytree:

@@ -10,6 +10,7 @@ Everything runs tiny (MLP on synthetic data) so the jitted local updates
 compile in seconds on the CPU mesh.
 """
 
+import dataclasses
 import socket
 import threading
 import time
@@ -304,3 +305,240 @@ def test_round_deadline_skips_stragglers_without_killing_them():
     finally:
         for s in servers:
             s.stop(0)
+
+
+# --------------------------------------------------------------------------
+# Round-4 regressions: replica payload typing, lineage round counter, stable
+# ranks under participation sampling, in-flight tracking across rounds.
+# --------------------------------------------------------------------------
+
+
+class _RecordingStub:
+    """Wraps a TrainerStub, recording StartTrain ranks and optionally
+    blocking calls on an event (to fabricate stragglers/slow broadcasts
+    without a special servicer)."""
+
+    def __init__(self, real):
+        self._real = real
+        self.ranks = []
+        self.send_calls = 0
+        self.block_train = None   # threading.Event: wait before forwarding
+        self.block_send_after = None  # (n, Event): block send calls > n
+
+    def StartTrain(self, request, timeout=None):
+        self.ranks.append(request.rank)
+        if self.block_train is not None:
+            self.block_train.wait()
+        return self._real.StartTrain(request, timeout=timeout)
+
+    def SendModel(self, request, timeout=None):
+        self.send_calls += 1
+        if (
+            self.block_send_after is not None
+            and self.send_calls > self.block_send_after[0]
+        ):
+            self.block_send_after[1].wait()
+        return self._real.SendModel(request, timeout=timeout)
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def _three_clients(cfg):
+    from fedtpu.transport.federation import serve_client as _serve
+
+    addrs, servers = [], []
+    for i in range(3):
+        addr = f"localhost:{free_port()}"
+        server, _ = _serve(addr, cfg, seed=i)
+        addrs.append(addr)
+        servers.append(server)
+    return addrs, servers
+
+
+def test_sampled_clients_keep_registry_rank():
+    """With participation_fraction < 1, each sampled client must train its
+    OWN registry-order shard — positional ranks would retrain shards 0..k-1
+    forever and never touch the rest (ADVICE r3)."""
+    cfg = tiny_cfg(num_clients=3)
+    cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, participation_fraction=0.34)
+    )
+    addrs, servers = _three_clients(cfg)
+    try:
+        primary = PrimaryServer(cfg, addrs)
+        stubs = {c: _RecordingStub(primary._stubs[c]) for c in addrs}
+        primary._stubs = stubs
+        for _ in range(6):
+            primary.round()
+        index = {c: i for i, c in enumerate(addrs)}
+        seen_ranks = set()
+        for c, stub in stubs.items():
+            for r in stub.ranks:
+                assert r == index[c], (c, stub.ranks)
+                seen_ranks.add(r)
+        # Sampling rotated through more than one client across 6 rounds, so
+        # a nonzero rank was actually exercised (positional assignment would
+        # have sent rank 0 every time at k=1).
+        assert seen_ranks != {0}, seen_ranks
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+def test_inflight_straggler_survives_multiple_rounds():
+    """A straggler whose StartTrain is still running TWO rounds later must
+    stay in _inflight (and keep sitting rounds out) — rebuilding _inflight
+    from only the current round's threads would hand it a second concurrent
+    StartTrain (ADVICE r3)."""
+    cfg = tiny_cfg(num_clients=3)
+    addrs, servers = _three_clients(cfg)
+    try:
+        primary = PrimaryServer(cfg, addrs, round_deadline_s=None)
+        primary.round()  # warmup: compile all clients, no deadline
+        stubs = {c: _RecordingStub(primary._stubs[c]) for c in addrs}
+        primary._stubs = stubs
+        gate = threading.Event()
+        stubs[addrs[0]].block_train = gate
+        primary.round_deadline_s = 2.0
+        rec1 = primary.round()
+        assert rec1["stragglers"] == 1
+        assert addrs[0] in primary._inflight
+        calls_after_r1 = len(stubs[addrs[0]].ranks)
+        rec2 = primary.round()  # straggler STILL in flight
+        assert rec2["stragglers"] == 1
+        # Regression: the straggler thread survived the _inflight rebuild...
+        assert addrs[0] in primary._inflight, "straggler dropped from _inflight"
+        assert primary._inflight[addrs[0]].is_alive()
+        rec3 = primary.round()  # ...so round 3 still does not re-launch it
+        assert rec3["stragglers"] == 1
+        assert len(stubs[addrs[0]].ranks) == calls_after_r1
+        gate.set()
+        primary._inflight[addrs[0]].join(timeout=30)
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+def test_broadcast_send_threads_tracked():
+    """A SendModel broadcast still in flight from the previous round must
+    not be raced by this round's broadcast to the same client (ADVICE r3):
+    the client sits the broadcast out until its stale send drains."""
+    cfg = tiny_cfg(num_clients=3)
+    addrs, servers = _three_clients(cfg)
+    try:
+        primary = PrimaryServer(cfg, addrs, round_deadline_s=None)
+        primary.round()  # warmup + initial sync
+        stubs = {c: _RecordingStub(primary._stubs[c]) for c in addrs}
+        primary._stubs = stubs
+        gate = threading.Event()
+        stubs[addrs[0]].block_send_after = (0, gate)  # block every send
+        primary.round_deadline_s = 2.0
+        primary.round()
+        assert addrs[0] in primary._sends
+        assert primary._sends[addrs[0]].is_alive()
+        sends_after_r1 = stubs[addrs[0]].send_calls
+        primary.round()
+        # No concurrent second SendModel was issued to the blocked client.
+        assert stubs[addrs[0]].send_calls == sends_after_r1
+        assert addrs[0] in primary._sends
+        gate.set()
+        primary._sends[addrs[0]].join(timeout=30)
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+def test_truncated_replica_raises_loudly():
+    """A corrupted replica payload must raise (explicit payload-kind flag),
+    never silently downgrade to model-only-and-drop-the-moments
+    (VERDICT r3 weak #6)."""
+    cfg = tiny_cfg()
+    cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, server_optimizer="momentum")
+    )
+    primary = PrimaryServer(cfg, [])
+    primary._round_counter = 3
+    data = primary.replica_bytes()
+    other = PrimaryServer(cfg, [])
+    with pytest.raises(wire.WireError):
+        other._install(data[: len(data) // 2])  # truncated: CRC mismatch
+    flipped = bytearray(data)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(wire.WireError):
+        other._install(bytes(flipped))  # bit flip: CRC mismatch
+    # And a config-mismatched replica (sender has no moments, receiver
+    # expects them) fails loudly instead of installing partial state.
+    plain_cfg = tiny_cfg()
+    sender = PrimaryServer(plain_cfg, [])
+    with pytest.raises(wire.WireError):
+        other._install(sender.replica_bytes())
+    # The intact replica installs fully: model + moments + round counter.
+    other._install(data)
+    assert other._round_counter == 3
+
+
+def test_replica_counter_continuity_across_promotion():
+    """The DP-noise / subsampling round counter must ride the replica so a
+    promoted backup (history restarts at 0) never replays round 0's PRNG
+    draws (ADVICE r3). Also covers: model-only payloads leave it alone."""
+    cfg = tiny_cfg()
+    primary = PrimaryServer(cfg, [])
+    primary._round_counter = 41
+    promoted = PrimaryServer(cfg, [], initial_model=primary.replica_bytes())
+    assert promoted._round_counter == 41
+    # A plain model broadcast (kind=model) must NOT reset the counter.
+    promoted._install(primary.model_bytes())
+    assert promoted._round_counter == 41
+
+
+def test_full_state_checkpoint_roundtrip(tmp_path):
+    """state_tree/install_state checkpoint: FedOpt moments and the round
+    counter survive a save/restore cycle (the server CLI resume path)."""
+    import jax
+
+    from fedtpu.checkpoint import Checkpointer
+
+    cfg = tiny_cfg()
+    cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, server_optimizer="adam")
+    )
+    primary = PrimaryServer(cfg, [])
+    primary._round_counter = 7
+    # Perturb the moments so the restore is distinguishable from init.
+    primary._server_opt_state = jax.tree.map(
+        lambda x: x + 1.25, primary._server_opt_state
+    )
+    ckpt = Checkpointer(str(tmp_path), backend="wire")
+    ckpt.save(6, primary.state_tree())
+    fresh = PrimaryServer(cfg, [])
+    r, tree = ckpt.restore_latest(fresh.state_template())
+    fresh.install_state(tree)
+    assert r == 6
+    assert fresh._round_counter == 7
+    a = np.concatenate([
+        np.ravel(np.asarray(x))
+        for x in jax.tree.leaves(primary._server_opt_state)
+    ])
+    b = np.concatenate([
+        np.ravel(np.asarray(x))
+        for x in jax.tree.leaves(fresh._server_opt_state)
+    ])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_promotion_survives_corrupted_replica():
+    """A corrupted replica blob must not silently kill the watchdog's
+    promotion (leaving NO primary): the backup logs loudly and promotes
+    with a fresh model instead."""
+    cfg = tiny_cfg()
+    backup = BackupServer(cfg, [], watchdog_timeout=3600.0)
+    good = PrimaryServer(cfg, [])
+    blob = bytearray(good.replica_bytes())
+    blob[-1] ^= 0xFF  # CRC mismatch
+    backup.latest_model = bytes(blob)
+    backup._promote()
+    try:
+        assert backup.acting is not None, "promotion died on corrupt replica"
+    finally:
+        backup._stop_acting(wait=30.0)

@@ -138,6 +138,16 @@ JOBS = [
 def run_pending(state, lock_file):
     """Run every not-yet-done job, serialized under the exclusive lock."""
     fcntl.flock(lock_file, fcntl.LOCK_EX)
+    # Reload AFTER acquiring the lock: another watcher may have completed
+    # jobs while we blocked, and acting on the pre-wait snapshot would
+    # re-run them (burning the scarce TPU window) and clobber its done-list.
+    fresh = load_state()
+    for name in fresh["done"]:
+        if name not in state["done"]:
+            state["done"].append(name)
+    state["history"] = fresh["history"] + [
+        h for h in state["history"] if h not in fresh["history"]
+    ]
     try:
         for name, job in JOBS:
             if name in state["done"]:

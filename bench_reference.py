@@ -86,11 +86,59 @@ class TorchSmallCNN(nn.Module):
         return self.fc2(F.relu(self.fc1(x)))
 
 
+class TorchBasicBlock(nn.Module):
+    def __init__(self, in_ch, ch, stride=1):
+        super().__init__()
+        self.c1 = nn.Conv2d(in_ch, ch, 3, stride=stride, padding=1, bias=False)
+        self.b1 = nn.BatchNorm2d(ch)
+        self.c2 = nn.Conv2d(ch, ch, 3, padding=1, bias=False)
+        self.b2 = nn.BatchNorm2d(ch)
+        self.short = None
+        if stride != 1 or in_ch != ch:
+            self.short = nn.Sequential(
+                nn.Conv2d(in_ch, ch, 1, stride=stride, bias=False),
+                nn.BatchNorm2d(ch),
+            )
+
+    def forward(self, x):
+        r = x if self.short is None else self.short(x)
+        y = F.relu(self.b1(self.c1(x)))
+        y = self.b2(self.c2(y))
+        return F.relu(y + r)
+
+
+class TorchResNet18(nn.Module):
+    # CIFAR-style ResNet-18, the torch twin of fedtpu/models/resnet.py
+    # (3x3/64 stem, BasicBlock stages (64,128,256,512)x2, strides 1/2/2/2,
+    # global average pool + dense head).
+    def __init__(self, num_classes=10, in_ch=3):
+        super().__init__()
+        self.stem = nn.Conv2d(in_ch, 64, 3, padding=1, bias=False)
+        self.bn = nn.BatchNorm2d(64)
+        layers = []
+        c_in = 64
+        for stage, ch in enumerate((64, 128, 256, 512)):
+            for i in range(2):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                layers.append(TorchBasicBlock(c_in, ch, stride))
+                c_in = ch
+        self.blocks = nn.Sequential(*layers)
+        self.fc = nn.Linear(512, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.bn(self.stem(x)))
+        x = self.blocks(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
 def build_model(spec):
     if spec["model"] == "mlp":
         shape = spec["input_shape"]
         feat = shape[0] * shape[1] * shape[2]
         return TorchMLP(spec["num_classes"], in_features=feat)
+    if spec["model"] == "resnet18":
+        return TorchResNet18(spec["num_classes"], in_ch=spec["input_shape"][2])
     return TorchSmallCNN(
         spec["num_classes"], in_ch=spec["input_shape"][2],
         spatial=spec["input_shape"][0],
@@ -136,12 +184,16 @@ class ClientTrainer(service.TrainerServicer):
         self.net.train()
         # local_epochs > 1 repeats the epoch loop (parity config 4; the
         # fedtpu engine folds epochs into steps the same way).
+        per_client = self.spec.get("per_client", False)
         for _ in range(self.spec["local_epochs"]):
             count = 0
             for i, bx, by in batches(self.x, self.y, self.spec["batch"]):
-                count = (count + 1) % request.world
-                if count != request.rank:
-                    continue  # round-robin shard rule, src/main.py:141-144
+                if not per_client:
+                    count = (count + 1) % request.world
+                    if count != request.rank:
+                        continue  # round-robin rule, src/main.py:141-144
+                # per_client mode: self.x IS this client's engine-identical
+                # shard (iid/dirichlet) — train every batch of it.
                 self.opt.zero_grad()
                 loss = F.cross_entropy(self.net(bx), by)
                 loss.backward()
@@ -168,7 +220,12 @@ def main():
     torch.manual_seed(0)
     servers = []
     for i, addr in enumerate(spec["addresses"]):
-        t = ClientTrainer(spec, x, y, os.path.join(spec["dir"], f"client_{i}.pth"))
+        if spec.get("per_client", False):
+            own = torch.from_numpy(data[f"shard_{i}"].astype(np.int64))
+            cx, cy = x[own], y[own]
+        else:
+            cx, cy = x, y
+        t = ClientTrainer(spec, cx, cy, os.path.join(spec["dir"], f"client_{i}.pth"))
         srv = service.create_server(
             addr, t, compress=spec["gzip"], max_workers=2
         )
@@ -251,7 +308,14 @@ def _server_round(stubs, world, workdir, proto, build, spec):
     return avg
 
 
-def run_config(name, parity_cfg, note=""):
+def run_config(name, parity_cfg, note="", curve_out=None,
+               engine_partition=False):
+    """``curve_out``: open file — appends one JSON line per round with the
+    global model's test accuracy (the per-round eval parity surface,
+    ``src/main.py:167-191``), for convergence-overlay artifacts.
+    ``engine_partition``: give each torch client the engine-identical
+    iid/dirichlet shard instead of the reference's round-robin rank rule
+    (accuracy-parity mode — identical data distributions both sides)."""
     import numpy as np
     import torch
     import torch.nn.functional as F
@@ -281,10 +345,30 @@ def run_config(name, parity_cfg, note=""):
     x, y = load(cfg.data.dataset, "train", seed=cfg.data.seed,
                 num=cfg.data.num_examples)
     data_file = os.path.join(workdir, "data.npz")
-    np.savez(data_file, x=x.astype(np.float32), y=y)
+    extra = {}
+    if engine_partition:
+        # Accuracy-parity mode: ship each client the EXACT shard the fedtpu
+        # engine assigns it (same partitioner, same seed), so both systems
+        # optimize over identical per-client data distributions. The speed
+        # configs keep the reference's own round-robin rank sharding — that
+        # IS its measured mechanic (src/main.py:140-144).
+        from fedtpu.data import partition as partition_mod
+
+        if cfg.data.partition == "dirichlet":
+            idx, maskm = partition_mod.dirichlet(
+                y, n_clients, alpha=cfg.data.dirichlet_alpha,
+                seed=cfg.data.seed,
+            )
+        else:
+            idx, maskm = partition_mod.iid(
+                len(x), n_clients, seed=cfg.data.seed
+            )
+        for i in range(n_clients):
+            extra[f"shard_{i}"] = np.asarray(idx[i][maskm[i]], np.int64)
+    np.savez(data_file, x=x.astype(np.float32), y=y, **extra)
 
     spec = {
-        "model": cfg.model if cfg.model in ("mlp",) else "smallcnn",
+        "model": cfg.model if cfg.model in ("mlp", "resnet18") else "smallcnn",
         "num_classes": cfg.num_classes,
         "input_shape": list(x.shape[1:]),
         "lr": cfg.opt.learning_rate,
@@ -294,6 +378,7 @@ def run_config(name, parity_cfg, note=""):
         "dir": workdir,
         "gzip": gzip_on,
         "data_file": data_file,
+        "per_client": engine_partition,
     }
     child_src = f"REPO = {os.path.dirname(os.path.abspath(__file__))!r}\n" + CLIENT_MAIN
     child = subprocess.Popen(
@@ -320,25 +405,40 @@ def run_config(name, parity_cfg, note=""):
         exec(TORCH_MODELS, ns)
         build = ns["build_model"]
 
-        # Warmup round, then timed rounds (same shape as bench_parity).
-        _server_round(stubs, n_clients, workdir, proto, build, spec)
-        t0 = time.perf_counter()
-        timed = cfg.fed.num_rounds - 1
-        for _ in range(timed):
-            avg = _server_round(stubs, n_clients, workdir, proto, build, spec)
-        dt = time.perf_counter() - t0
-
-        # Test accuracy of the final global model.
         tx, ty = load(cfg.data.dataset, "test", seed=cfg.data.seed,
                       num=cfg.data.num_examples)
-        model = build(spec)
-        model.load_state_dict(avg)
-        model.eval()
-        with torch.no_grad():
-            logits = model(
-                torch.from_numpy(tx.transpose(0, 3, 1, 2).copy())
-            )
-            acc = float((logits.argmax(1).numpy() == ty).mean())
+        tx_t = torch.from_numpy(tx.transpose(0, 3, 1, 2).copy())
+        eval_model = build(spec)
+
+        def _eval(avg_state):
+            eval_model.load_state_dict(avg_state)
+            eval_model.eval()
+            with torch.no_grad():
+                logits = eval_model(tx_t)
+            return float((logits.argmax(1).numpy() == ty).mean())
+
+        # Warmup round, then timed rounds (same shape as bench_parity).
+        # Curve rows are written per round; evals run OUTSIDE the timer so
+        # the rounds/sec column stays comparable to the no-curve runs.
+        avg = _server_round(stubs, n_clients, workdir, proto, build, spec)
+        if curve_out is not None:
+            curve_out.write(json.dumps(
+                {"system": "reference_grpc_torch", "config": name,
+                 "round": 0, "test_acc": round(_eval(avg), 4)}) + "\n")
+            curve_out.flush()
+        timed = cfg.fed.num_rounds - 1
+        dt = 0.0
+        for r in range(timed):
+            t0 = time.perf_counter()
+            avg = _server_round(stubs, n_clients, workdir, proto, build, spec)
+            dt += time.perf_counter() - t0
+            if curve_out is not None:
+                curve_out.write(json.dumps(
+                    {"system": "reference_grpc_torch", "config": name,
+                     "round": r + 1, "test_acc": round(_eval(avg), 4)}) + "\n")
+                curve_out.flush()
+
+        acc = _eval(avg)
 
         wire_bytes = 2 * n_clients * len(
             base64.b64encode(open(os.path.join(workdir, "optimizedModel.pth"), "rb").read())
@@ -353,6 +453,10 @@ def run_config(name, parity_cfg, note=""):
             "dataset": cfg.data.dataset,
             "gzip": gzip_on,
             "wire_bytes_per_round": wire_bytes,
+            "partition": (
+                f"engine-identical {cfg.data.partition}" if engine_partition
+                else "reference round-robin"
+            ),
             "note": note,
         }
     finally:
@@ -364,18 +468,35 @@ def run_config(name, parity_cfg, note=""):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--only", default=None)
+    p.add_argument("--acc-scale", action="store_true",
+                   help="run bench_parity's accuracy-parity configs (the "
+                   "specified conv models on the non-saturating *_hard "
+                   "tasks) instead of the --cpu-scale speed configs")
+    p.add_argument("--curve-out", default=None,
+                   help="append per-round test-acc JSONL rows to this file")
     args = p.parse_args()
 
     import bench_parity
 
     notes = {
         "3_fedprox_cnn_cifar10_32c": "reference has no FedProx; baseline is its plain FedAvg",
+        "3_acc_fedprox_smallcnn_cifar10h_32c": "reference has no FedProx; baseline is its plain FedAvg",
         "5_topk_compressed_fedavg_128c": "reference -c Y == transport gzip (no top-k)",
     }
-    for name, cfg in bench_parity.configs(quick=False, cpu_scale=True):
-        if args.only and args.only not in name:
-            continue
-        print(json.dumps(run_config(name, cfg, notes.get(name, ""))), flush=True)
+    gen = (bench_parity.acc_configs() if args.acc_scale
+           else bench_parity.configs(quick=False, cpu_scale=True))
+    curve = open(args.curve_out, "a") if args.curve_out else None
+    try:
+        for name, cfg in gen:
+            if args.only and args.only not in name:
+                continue
+            print(json.dumps(
+                run_config(name, cfg, notes.get(name, ""), curve_out=curve,
+                           engine_partition=args.acc_scale)
+            ), flush=True)
+    finally:
+        if curve is not None:
+            curve.close()
 
 
 if __name__ == "__main__":

@@ -45,6 +45,26 @@ def main(argv=None) -> int:
         "rounds — numerically identical to per-round stepping. Eval and "
         "checkpointing happen at block boundaries. 1 = dispatch per round.",
     )
+    p.add_argument(
+        "--async-updates",
+        default=0,
+        type=int,
+        metavar="N",
+        help="run the ENGINE-side FedBuff async mode for N server updates "
+        "instead of synchronous rounds: every live client trains its own "
+        "model copy each tick, --buffer-k clients report per tick with "
+        "staleness-discounted weights (fedtpu.core.async_engine; the "
+        "simulated twin of the gRPC server's --async-updates)",
+    )
+    p.add_argument("--buffer-k", default=2, type=int)
+    p.add_argument("--staleness-power", default=0.5, type=float)
+    p.add_argument(
+        "--speed-sigma",
+        default=0.0,
+        type=float,
+        help="client-speed heterogeneity for async arrivals (log-normal "
+        "sigma; 0 = uniform). Larger -> slow clients accumulate staleness",
+    )
     p.add_argument("--eval-every", default=5, type=int)
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
     p.add_argument("--checkpoint-dir", default=None)
@@ -63,6 +83,8 @@ def main(argv=None) -> int:
     cfg = build_config(
         args, num_clients=args.num_clients, steps_per_round=args.steps_per_round
     )
+    if args.async_updates:
+        return _run_async(args, cfg)
     mesh = None
     if args.mesh == "auto":
         import jax
@@ -163,6 +185,72 @@ def main(argv=None) -> int:
         "%d rounds in %.1fs (%.2f rounds/s)", done, dt, done / max(dt, 1e-9)
     )
     return 0
+
+
+def _run_async(args, cfg) -> int:
+    """Engine-side FedBuff loop (fedtpu.core.async_engine): --async-updates
+    server updates, --fused-sized scan blocks, eval at block boundaries."""
+    from fedtpu.core import AsyncFederation
+
+    if args.checkpoint_dir:
+        logging.warning("--checkpoint-dir is ignored in async mode")
+    if args.progress:
+        logging.warning("--progress is ignored in async mode")
+    # --mesh is single-program here by design (the async engine is a
+    # single-chip study tool); --profile-dir IS honored below.
+    fed = AsyncFederation(
+        cfg,
+        seed=args.seed,
+        buffer_k=args.buffer_k,
+        staleness_power=args.staleness_power,
+        speed_sigma=args.speed_sigma,
+    )
+    logger = MetricsLogger(path=args.metrics, echo=True)
+    eval_data = load(
+        args.dataset, "test", seed=args.seed, num=args.num_examples
+    )
+    from fedtpu.utils.progress import profile_rounds
+
+    t0 = time.time()
+    with profile_rounds(args.profile_dir):
+        _async_loop(args, fed, logger, eval_data)
+    dt = time.time() - t0
+    logging.info(
+        "%d async updates in %.1fs (%.2f updates/s)",
+        args.async_updates, dt, args.async_updates / max(dt, 1e-9),
+    )
+    return 0
+
+
+def _async_loop(args, fed, logger, eval_data) -> None:
+    t = 0
+    while t < args.async_updates:
+        block = min(max(1, args.fused), args.async_updates - t)
+        if block > 1:
+            m = fed.run_on_device(block)
+            losses = np.asarray(m.loss)
+            stale = np.asarray(m.staleness_mean)
+            rows = [
+                (float(losses[i]), float(stale[i])) for i in range(block)
+            ]
+        else:
+            m = fed.tick()
+            rows = [(float(m.loss), float(m.staleness_mean))]
+        crossed_eval = args.eval_every and (
+            (t + block) // args.eval_every > t // args.eval_every
+        )
+        for i, (loss, stal) in enumerate(rows):
+            rec = {
+                "loss": loss,
+                "staleness": stal,
+                "buffer_k": args.buffer_k,
+                "dataset": fed.cfg.data.dataset,
+                "data_source": fed.data_source,
+            }
+            if crossed_eval and i == len(rows) - 1:
+                rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
+            logger.log(t + i, **rec)
+        t += block
 
 
 if __name__ == "__main__":

@@ -1,3 +1,9 @@
+from fedtpu.core.async_engine import (
+    AsyncFederation,
+    AsyncMetrics,
+    AsyncState,
+    make_async_step,
+)
 from fedtpu.core.engine import Federation
 from fedtpu.core.round import (
     FederatedState,
@@ -12,6 +18,10 @@ from fedtpu.core.solo import SoloTrainer, run_solo
 __all__ = [
     "SoloTrainer",
     "run_solo",
+    "AsyncFederation",
+    "AsyncMetrics",
+    "AsyncState",
+    "make_async_step",
     "Federation",
     "FederatedState",
     "RoundBatch",

@@ -109,8 +109,14 @@ def make_local_update(
 
     def _run_scan(
         global_params, global_stats, opt_state, step_elems, get_xy,
-        steps, step_mask, rng, round_idx,
+        steps, step_mask, rng, round_idx, anchor=None,
     ) -> ClientOutput:
+        # FedProx proximal ANCHOR: defaults to the scan's initial params
+        # (synchronous rounds start from the global model, so the two
+        # coincide). The async engine passes the client's last-PULLED global
+        # explicitly — its scan starts from the client's own diverged
+        # trajectory, and anchoring there would make mu a per-tick no-op.
+        anchor = global_params if anchor is None else anchor
         lr = cfg.opt.lr_at(round_idx)
 
         def one_step(carry, batch):
@@ -118,7 +124,7 @@ def make_local_update(
             elem, live, step_rng = batch
             x, y = get_xy(elem)
             (loss, (new_stats, ce, acc)), grads = grad_fn(
-                params, stats, global_params, x, y, step_rng
+                params, stats, anchor, x, y, step_rng
             )
             new_params, new_ostate = optim.apply(params, grads, ostate, lr, cfg.opt)
             # Masked steps (padding of ragged shards / dead clients) change
@@ -165,6 +171,7 @@ def make_local_update(
             step_mask: jnp.ndarray,
             rng: jax.Array,
             round_idx: jnp.ndarray,
+            anchor: Pytree = None,
         ) -> ClientOutput:
             # Each scan step gathers only its own [batch]-sized slice from
             # the device-resident dataset — nothing [steps, batch, ...]-sized
@@ -180,7 +187,7 @@ def make_local_update(
             return _run_scan(
                 global_params, global_stats, opt_state,
                 takes, get_xy,
-                takes.shape[0], step_mask, rng, round_idx,
+                takes.shape[0], step_mask, rng, round_idx, anchor,
             )
 
     else:
@@ -194,11 +201,12 @@ def make_local_update(
             step_mask: jnp.ndarray,
             rng: jax.Array,
             round_idx: jnp.ndarray,
+            anchor: Pytree = None,
         ) -> ClientOutput:
             return _run_scan(
                 global_params, global_stats, opt_state,
                 (xs, ys), lambda e: e,
-                xs.shape[0], step_mask, rng, round_idx,
+                xs.shape[0], step_mask, rng, round_idx, anchor,
             )
 
     return local_update

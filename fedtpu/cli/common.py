@@ -153,6 +153,13 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         help="how the sampled subset is drawn: uniform, or importance "
         "sampling proportional to each client's last training loss",
     )
+    p.add_argument(
+        "--debug-per-batch",
+        action="store_true",
+        help="print per-batch loss/acc from inside the jitted local epoch "
+        "(the reference's mid-epoch console lines, src/utils.py:51-92). "
+        "Host callback per batch — debugging only, ruins throughput",
+    )
 
 
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
@@ -203,6 +210,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
         ),
         steps_per_round=steps_per_round,
+        debug_per_batch=getattr(args, "debug_per_batch", False),
     )
 
 

@@ -146,6 +146,12 @@ class RoundConfig:
     # trades recompute FLOPs for HBM so big vmapped-client configs fit one
     # chip (measured: BASELINE.md config 4 OOMs one v5e without it).
     remat: bool = False
+    # Per-batch console feedback from INSIDE the jitted local epoch
+    # (jax.debug.print) — the reference prints loss/acc per batch mid-epoch
+    # (src/utils.py:51-92, called at src/main.py:124,158). Off by default:
+    # each print is a host callback that serialises the device against the
+    # host, so this is a debugging aid, never a benchmarking mode.
+    debug_per_batch: bool = False
 
 
 DEFAULT_ROUND_CONFIG = RoundConfig()

@@ -126,6 +126,13 @@ def make_local_update(
             (loss, (new_stats, ce, acc)), grads = grad_fn(
                 params, stats, anchor, x, y, step_rng
             )
+            if cfg.debug_per_batch:
+                # Reference parity (src/utils.py:51-92): per-batch loss/acc
+                # lines mid-epoch. A host callback per batch — debugging
+                # only; under vmap one line prints per client per batch.
+                jax.debug.print(
+                    "  batch: loss {l:.4f} acc {a:.4f}", l=ce, a=acc
+                )
             new_params, new_ostate = optim.apply(params, grads, ostate, lr, cfg.opt)
             # Masked steps (padding of ragged shards / dead clients) change
             # nothing — the reference equivalent is the client simply not

@@ -41,25 +41,36 @@ def main():
             system = "fedtpu"
         by_cfg[r["config"]][system] = r
 
+    curve_by = defaultdict(lambda: defaultdict(list))
+    for c in curves:
+        curve_by[c["config"]][c["system"]].append((c["round"], c["test_acc"]))
+
+    def final_acc(cfg, system_key, summary_row):
+        """Summary-row accuracy, else the curve's final round (a run whose
+        summary was lost to a timeout still has its full curve)."""
+        if summary_row is not None:
+            return summary_row["test_acc"], ""
+        name = "fedtpu" if system_key == "fedtpu" else "reference_grpc_torch"
+        pts = sorted(curve_by.get(cfg, {}).get(name, []))
+        if pts:
+            return pts[-1][1], " (curve final)"
+        return float("nan"), ""
+
     print("### Accuracy parity at the specified conv models "
           "(non-saturating task)\n")
     print("| config | model | clients | fedtpu test-acc | reference "
           "test-acc | gap |")
     print("|---|---|---|---|---|---|")
-    for cfg in sorted(by_cfg):
-        pair = by_cfg[cfg]
+    for cfg in sorted(set(by_cfg) | set(curve_by)):
+        pair = by_cfg.get(cfg, {})
         f, r = pair.get("fedtpu"), pair.get("ref")
-        fa = f["test_acc"] if f else float("nan")
-        ra = r["test_acc"] if r else float("nan")
+        fa, fnote = final_acc(cfg, "fedtpu", f)
+        ra, rnote = final_acc(cfg, "ref", r)
         model = (f or r or {}).get("model", "?")
         clients = (f or r or {}).get("num_clients", "?")
-        gap = fa - ra if f and r else float("nan")
-        print(f"| {cfg} | {model} | {clients} | {fa:.3f} | {ra:.3f} "
-              f"| {gap:+.3f} |")
-
-    curve_by = defaultdict(lambda: defaultdict(list))
-    for c in curves:
-        curve_by[c["config"]][c["system"]].append((c["round"], c["test_acc"]))
+        gap = fa - ra
+        print(f"| {cfg} | {model} | {clients} | {fa:.3f}{fnote} "
+              f"| {ra:.3f}{rnote} | {gap:+.3f} |")
 
     print("\n### Convergence dynamics (per-round test accuracy)\n")
     for cfg in sorted(curve_by):

@@ -9,20 +9,24 @@ staleness-weighted aggregation expressed as one jitted XLA program over the
 simulated client axis.
 
 Discretized-time semantics (documented, deliberate): one engine *tick* is
-one wall-clock unit in which EVERY live client trains one local epoch on its
-own model copy (``vmap`` over per-client parameters — unlike the synchronous
-round step, clients here genuinely hold diverged models). An *arrival
-schedule* — [ticks, clients] boolean masks with ``buffer_k`` true per tick,
-host-chosen — decides which clients report each tick. An arriving client
-contributes ``local_params - its_pull_snapshot`` (everything it trained
-since it last pulled, possibly several epochs), weighted
+one wall-clock unit. A live client that has not yet trained since its last
+pull trains ONE local epoch on its own model copy this tick (``vmap`` over
+per-client parameters — unlike the synchronous round step, clients here
+genuinely hold diverged models), then holds that pending update until it
+*arrives*. An *arrival schedule* — [ticks, clients] boolean masks with
+``buffer_k`` true per tick, host-chosen — decides which clients report each
+tick. An arriving client contributes ``local_params - its_pull_snapshot``
+(exactly one local epoch computed against a possibly-stale base — the
+FedBuff client cycle: pull, train once, submit; NOT a compounding open-ended
+trajectory), weighted
 ``(examples if weighted else 1) / (1 + staleness)**staleness_power`` where
 staleness counts server updates since its pull (FedBuff, Nguyen et al.
 2022 — the same rule as ``run_async``,
-:mod:`fedtpu.transport.federation`). After aggregation the arrivals re-pull
-the fresh global model; everyone else keeps training their stale trajectory.
-No barrier anywhere: the reference's join-on-slowest
-(``src/server.py:132-135``) simply has no counterpart here.
+:mod:`fedtpu.transport.federation`, whose gRPC clients likewise train one
+cycle per pull). After aggregation the arrivals re-pull the fresh global
+model and train anew next tick; clients awaiting arrival idle. No barrier
+anywhere: the reference's join-on-slowest (``src/server.py:132-135``)
+simply has no counterpart here.
 
 Composition limits mirror ``run_async`` and are rejected at build time:
 mean aggregator only (a K-sized buffer is too small a population for robust
@@ -69,6 +73,9 @@ class AsyncState(NamedTuple):
     client_rng: jnp.ndarray
     base_version: jnp.ndarray  # [clients] int32
     version: jnp.ndarray       # scalar int32: server updates so far
+    # True = this client has trained its one epoch since its last pull and
+    # is holding the update until it arrives (it idles meanwhile).
+    pending: jnp.ndarray = ()
     server_opt_state: Pytree = ()
     last_client_loss: jnp.ndarray = ()
 
@@ -130,6 +137,7 @@ def init_async_state(
         client_rng=base.client_rng,
         base_version=jnp.zeros((n,), jnp.int32),
         version=jnp.zeros((), jnp.int32),
+        pending=jnp.zeros((n,), jnp.bool_),
         server_opt_state=base.server_opt_state,
         last_client_loss=base.last_client_loss,
     )
@@ -189,9 +197,11 @@ def make_async_step(
         x = images[take].reshape((n, steps, batch_size) + tail)
         y = labels[take].reshape((n, steps, batch_size))
         has_data = mask.any(axis=1)
-        step_mask = jnp.broadcast_to(
-            (has_data & alive)[:, None], (n, steps)
-        )
+        # One epoch per pull cycle (the FedBuff client loop): a client that
+        # already holds a pending update idles until it arrives — masked
+        # steps are no-ops, so its params/momentum stay frozen.
+        trains = has_data & alive & ~state.pending
+        step_mask = jnp.broadcast_to(trains[:, None], (n, steps))
         rngs = jax.vmap(jax.random.fold_in)(
             state.client_rng, jnp.broadcast_to(state.version, (n,))
         )
@@ -251,16 +261,16 @@ def make_async_step(
         )
         arrived_f = arrive.astype(jnp.float32)
         n_arrived = jnp.sum(arrived_f)
-        alive_f = (alive & has_data).astype(jnp.float32)
-        n_trained = jnp.maximum(jnp.sum(alive_f), 1.0)
+        trains_f = trains.astype(jnp.float32)
+        n_trained = jnp.maximum(jnp.sum(trains_f), 1.0)
         metrics = AsyncMetrics(
-            loss=jnp.sum(out.loss * alive_f) / n_trained,
-            accuracy=jnp.sum(out.accuracy * alive_f) / n_trained,
+            loss=jnp.sum(out.loss * trains_f) / n_trained,
+            accuracy=jnp.sum(out.accuracy * trains_f) / n_trained,
             num_arrived=n_arrived,
             staleness_mean=jnp.sum(staleness * arrived_f)
             / jnp.maximum(n_arrived, 1.0),
             update_norm=trees.tree_norm(mean_delta),
-            per_client_loss=out.loss * alive_f,
+            per_client_loss=out.loss * trains_f,
         )
         new_state = AsyncState(
             params=new_params,
@@ -273,9 +283,12 @@ def make_async_step(
             client_rng=state.client_rng,
             base_version=jnp.where(arrive, new_version, state.base_version),
             version=new_version,
+            # Arrivals re-pull and train anew next tick; a client that
+            # trained this tick holds its update until it arrives.
+            pending=(state.pending | trains) & ~arrive,
             server_opt_state=new_server_opt,
             last_client_loss=jnp.where(
-                alive & has_data,
+                trains,
                 out.loss.astype(jnp.float32),
                 state.last_client_loss,
             ),

@@ -143,17 +143,58 @@ def test_async_rejects_unsound_compositions():
         AsyncFederation(tiny_cfg(), buffer_k=9)
 
 
-def test_fedprox_anchors_to_pulled_global():
-    """FedProx's proximal term must anchor to the client's last PULLED
-    global (base_params), not its own tick-start params — anchoring there
-    is ~0 at every tick start and never pulls diverged clients back."""
+def test_fedprox_anchor_parameter_pulls_toward_anchor():
+    """The local update's explicit FedProx anchor must be the proximal
+    center: with a strong (stable) mu, one epoch started at params != anchor moves
+    TOWARD the anchor (an anchor wrongly tied to the scan's init would add
+    ~zero proximal force)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedtpu import models
+    from fedtpu.core import make_local_update, optim
+
+    cfg = dataclasses.replace(
+        tiny_cfg(num_clients=1),
+        # lr*mu must stay < 1 for the prox step to be stable
+        fed=FedConfig(num_clients=1, algorithm="fedprox", fedprox_mu=5.0),
+    )
+    model = models.create("mlp", num_classes=10)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+    )
+    init_params = variables["params"]
+    anchor = jax.tree.map(lambda x: x + 0.5, init_params)
+    lu = jax.jit(make_local_update(model.apply, cfg))
+    x = jnp.zeros((2, 8, 32, 32, 3), jnp.float32)
+    y = jnp.zeros((2, 8), jnp.int32)
+    out = lu(
+        init_params, {}, optim.init(init_params), x, y,
+        jnp.ones((2,), bool), jax.random.PRNGKey(1),
+        jnp.zeros((), jnp.int32), anchor,
+    )
+
+    def dist(a, b):
+        return float(sum(
+            np.linalg.norm(np.asarray(x - y))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        ))
+
+    assert dist(out.params, anchor) < dist(init_params, anchor)
+
+
+def test_fedprox_damps_async_client_drift():
+    """In the async engine the prox term (anchored at the pulled global)
+    reduces per-cycle client drift."""
     import jax
 
     def drift(mu):
         fed_kw = dict(algorithm="fedprox", fedprox_mu=mu) if mu else {}
         cfg = tiny_cfg(num_clients=3, **fed_kw)
         a = AsyncFederation(cfg, seed=0, buffer_k=1, speed_sigma=0.0)
-        # Client 2 NEVER arrives: it keeps training its local trajectory.
+        # Client 2 NEVER arrives: it trains one pending epoch and idles.
         schedule = [np.array([True, False, False]),
                     np.array([False, True, False])] * 4
         a._arrive_mask = lambda: schedule.pop(0)
@@ -167,4 +208,26 @@ def test_fedprox_anchors_to_pulled_global():
 
     d_plain = drift(0.0)
     d_prox = drift(10.0)
-    assert d_prox < 0.5 * d_plain, (d_prox, d_plain)
+    assert d_prox < d_plain, (d_prox, d_plain)
+
+
+def test_one_epoch_per_pull_cycle():
+    """FedBuff client loop: after training its single pending epoch, a
+    client that never arrives IDLES (no compounding local trajectory) —
+    matching run_async's gRPC clients, which train once per pull."""
+    import jax
+
+    cfg = tiny_cfg(num_clients=2)
+    a = AsyncFederation(cfg, seed=0, buffer_k=1, speed_sigma=0.0)
+    a._arrive_mask = lambda: np.array([True, False])  # client 1 never arrives
+
+    def c1_params():
+        return _flat(jax.tree.map(lambda x: x[1], a.state.client_params))
+
+    a.tick()
+    after_first = c1_params()
+    for _ in range(4):
+        a.tick()
+    np.testing.assert_array_equal(after_first, c1_params())
+    assert bool(a.state.pending[1])
+    assert not bool(a.state.pending[0])  # arrived + re-pulled, trains anew

@@ -87,6 +87,11 @@ def configs(quick: bool, cpu_scale: bool = False):
                     partition=partition,
                     num_examples=n_local,
                     augment=False,
+                    # Committed parity artifacts were measured under the
+                    # exact per-round permutation shuffle; pin it so re-runs
+                    # reproduce them (the engine default is now the faster
+                    # rotation layout, fedtpu/data/device.py).
+                    device_layout="gather",
                     **data_kw,
                 ),
                 fed=FedConfig(num_clients=clients, num_rounds=rounds,
@@ -111,6 +116,7 @@ def configs(quick: bool, cpu_scale: bool = False):
                 partition=partition,
                 num_examples=n,
                 augment=not quick,
+                device_layout="gather",  # pin committed-artifact semantics
                 **data_kw,
             ),
             fed=FedConfig(num_clients=clients, num_rounds=rounds,
@@ -167,6 +173,7 @@ def acc_configs():
                 partition=partition,
                 num_examples=ex_per_client * clients,
                 augment=False,
+                device_layout="gather",  # pin committed-artifact semantics
                 **data_kw,
             ),
             fed=FedConfig(num_clients=clients, num_rounds=rounds,

@@ -72,6 +72,20 @@ class DataConfig:
     # Truncate the loaded dataset (None = full). Mainly for tests and quick
     # runs; the reference always trains on the full set.
     num_examples: Optional[int] = None
+    # HBM layout of the device-resident dataset (fedtpu.data.device).
+    #   "presharded": the dataset is reorganised ONCE at upload into
+    #     [clients, 2*shard_len, features] (each client's shard, cycled to
+    #     pad and stored twice along the shard axis), so each round's batch
+    #     extraction is ONE contiguous dynamic-slice at a per-round rotation
+    #     offset. Measured motivation: the gather layout's computed-index
+    #     row-gather lowers on TPU to ~2 us dynamic-slice loops per example
+    #     (~250k ops/dispatch at the 64-client CIFAR bench,
+    #     artifacts/MFU_PROFILE_r04.json) and dominates the fused round.
+    #   "gather": dataset stays [N, features]; batches come from a per-round
+    #     index gather (exact per-round permutation shuffling, arbitrary
+    #     shard-length raggedness, no 2x data HBM). The exact semantics of
+    #     rounds 1-3 artifacts.
+    device_layout: str = "presharded"  # presharded | gather
 
 
 @dataclasses.dataclass(frozen=True)

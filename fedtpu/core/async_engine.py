@@ -150,6 +150,7 @@ def make_async_step(
     staleness_power: float = 0.5,
     shuffle: bool = True,
     image_shape: Optional[Tuple[int, ...]] = None,
+    layout: str = "presharded",
 ) -> Callable[..., Tuple[AsyncState, AsyncMetrics]]:
     """One tick: every live client trains ``steps`` batches on its OWN
     model; arriving clients' accumulated deltas aggregate into the global.
@@ -192,10 +193,21 @@ def make_async_step(
         rng = (
             jax.random.fold_in(data_key, state.version) if shuffle else None
         )
-        take = round_take_indices(idx, mask, need, rng)
-        tail = shape if images.ndim == 2 else tuple(images.shape[1:])
-        x = images[take].reshape((n, steps, batch_size) + tail)
-        y = labels[take].reshape((n, steps, batch_size))
+        if layout == "presharded":
+            # Contiguous rotated slice of the per-client rows (see
+            # fedtpu.data.device: the gather below was measured to dominate
+            # the fused tick on TPU, artifacts/MFU_PROFILE_r04.json).
+            from fedtpu.data.device import _round_offset, presharded_window
+
+            off, _ = _round_offset(labels, shuffle, rng)
+            x, y = presharded_window(
+                images, labels, off, steps, batch_size, shape
+            )
+        else:
+            take = round_take_indices(idx, mask, need, rng)
+            tail = shape if images.ndim == 2 else tuple(images.shape[1:])
+            x = images[take].reshape((n, steps, batch_size) + tail)
+            y = labels[take].reshape((n, steps, batch_size))
         has_data = mask.any(axis=1)
         # One epoch per pull cycle (the FedBuff client loop): a client that
         # already holds a pending update idles until it arrives — masked
@@ -306,13 +318,14 @@ def make_multi_async_step(
     staleness_power: float = 0.5,
     shuffle: bool = True,
     image_shape: Optional[Tuple[int, ...]] = None,
+    layout: str = "presharded",
 ):
     """``num_ticks`` ticks as ONE ``lax.scan`` program (the async analogue of
     :func:`fedtpu.data.device.make_multi_round_step`): ``arrive`` and
     ``alive`` become ``[num_ticks, clients]`` scan inputs, metrics come back
     stacked."""
     body = make_async_step(
-        model, cfg, steps, staleness_power, shuffle, image_shape
+        model, cfg, steps, staleness_power, shuffle, image_shape, layout
     )
 
     def multi(state, images, labels, idx, mask, weights, arrive, alive,
@@ -375,6 +388,7 @@ class AsyncFederation:
             make_async_step(
                 self.model, cfg, self._fed._steps, staleness_power,
                 shuffle=self._fed._shuffle, image_shape=self._fed._img_shape,
+                layout=self._fed._layout,
             ),
             donate_argnums=(0,),
         )
@@ -439,6 +453,7 @@ class AsyncFederation:
                     self.model, self.cfg, self._fed._steps, num_ticks,
                     self.staleness_power, shuffle=self._fed._shuffle,
                     image_shape=self._fed._img_shape,
+                    layout=self._fed._layout,
                 ),
                 donate_argnums=(0,),
             )

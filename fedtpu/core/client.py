@@ -52,17 +52,25 @@ def make_local_update(
     ``step_mask: [steps]`` (False steps are no-ops so ragged shards keep
     static shapes).
 
-    With ``stream=True`` the signature becomes
+    With ``stream`` set the signature becomes
 
         local_update(global_params, global_stats, opt_state, images, labels,
                      takes, step_mask, rng, round_idx)
 
-    with ``takes: [steps, batch]`` int32 indices into the device-resident
-    ``images``/``labels``: each scan step gathers ITS batch only, so the
-    round never materialises the full ``[steps, batch, ...]`` tensor — the
-    HBM lever that (with remat) fits 64-client resnet18 rounds on one chip
-    (see BASELINE.md config 4 / tools/compile_pallas_tpu.py).
+    and each scan step extracts ITS batch only, so the round never
+    materialises the full ``[steps, batch, ...]`` tensor — the HBM lever
+    that (with remat) fits 64-client resnet18 rounds on one chip (see
+    BASELINE.md config 4 / tools/compile_pallas_tpu.py). Two forms:
+    ``stream="gather"`` (alias ``True``): ``takes: [steps, batch]`` int32
+    indices into the flat device-resident dataset. ``stream="presharded"``:
+    ``images``/``labels`` are THIS client's presharded rows ``[2L, ...]``
+    (:func:`fedtpu.data.device.preshard_arrays`) and ``takes: [steps]``
+    per-step slice offsets — the extraction is a contiguous ``dynamic_slice``
+    instead of a row-gather (the measured ~100x per-byte difference on TPU;
+    see ``fedtpu/data/device.py``).
     """
+    if stream is True:
+        stream = "gather"
     mu = cfg.fed.fedprox_mu if cfg.fed.algorithm == "fedprox" else 0.0
     compute_dtype = jnp.dtype(cfg.dtype)
     # Random crop + flip for CIFAR-style training, fused into the jitted step
@@ -165,7 +173,44 @@ def make_local_update(
             num_steps=jnp.sum(lives),
         )
 
-    if stream:
+    if stream == "presharded":
+        shape = tuple(image_shape or cfg.image_size)
+        batch_size = cfg.data.batch_size
+
+        def local_update(
+            global_params: Pytree,
+            global_stats: Pytree,
+            opt_state: optim.SGDState,
+            images: jnp.ndarray,
+            labels: jnp.ndarray,
+            takes: jnp.ndarray,
+            step_mask: jnp.ndarray,
+            rng: jax.Array,
+            round_idx: jnp.ndarray,
+            anchor: Pytree = None,
+        ) -> ClientOutput:
+            # images/labels are THIS client's [2L, ...] presharded rows;
+            # each scan step slices its [batch]-sized window at the step's
+            # offset — one contiguous DMA, no gather.
+            f_tail = tuple(images.shape[1:])
+
+            def get_xy(o):
+                x = jax.lax.dynamic_slice(
+                    images, (o,) + (0,) * len(f_tail),
+                    (batch_size,) + f_tail,
+                )
+                if x.ndim == 2:
+                    x = x.reshape((batch_size,) + shape)
+                y = jax.lax.dynamic_slice(labels, (o,), (batch_size,))
+                return x, y
+
+            return _run_scan(
+                global_params, global_stats, opt_state,
+                takes, get_xy,
+                takes.shape[0], step_mask, rng, round_idx, anchor,
+            )
+
+    elif stream:
         shape = tuple(image_shape or cfg.image_size)
 
         def local_update(

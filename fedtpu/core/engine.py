@@ -83,6 +83,11 @@ class Federation:
                 "observations would desynchronise the sampling masks. Use "
                 "'uniform' on multi-controller deployments."
             )
+        if cfg.data.device_layout not in ("presharded", "gather"):
+            raise ValueError(
+                f"unknown device_layout {cfg.data.device_layout!r}; "
+                "have presharded | gather"
+            )
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -137,6 +142,27 @@ class Federation:
         )
         shuffle = cfg.data.partition != "round_robin"
         img_shape = tuple(images.shape[1:])
+        layout = cfg.data.device_layout
+        if layout == "presharded":
+            # Footprint guard: presharded costs clients * 2L floats of
+            # labels-side rows where L is the padded MAX shard length, so a
+            # skewed partition (low-alpha dirichlet) can inflate far beyond
+            # the 2x-dataset cost of the balanced case. Fall back to the
+            # gather layout (correct for every shape, just slower on TPU)
+            # rather than OOM.
+            footprint = 2 * n * idx.shape[1]
+            if footprint > 4 * len(images):
+                import warnings
+
+                warnings.warn(
+                    f"device_layout='presharded' would store "
+                    f"{footprint / len(images):.1f}x the dataset (skewed "
+                    f"partition: max shard {idx.shape[1]} of {len(images)} "
+                    f"examples x {n} clients); falling back to 'gather'",
+                    stacklevel=2,
+                )
+                layout = "gather"
+        self._layout = layout
         if mesh is None:
             self._round_step = jax.jit(
                 make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
@@ -144,7 +170,7 @@ class Federation:
             self._data_step = jax.jit(
                 make_data_round_step(
                     self.model, cfg, self._steps, compressor, shuffle=shuffle,
-                    image_shape=img_shape,
+                    image_shape=img_shape, layout=layout,
                 ),
                 donate_argnums=(0,),
             )
@@ -157,7 +183,7 @@ class Federation:
             )
             self._data_step = make_sharded_data_round_step(
                 self.model, cfg, self._steps, mesh, compressor, shuffle=shuffle,
-                image_shape=img_shape,
+                image_shape=img_shape, layout=layout,
             )
             # self.state was already mesh-placed by the property setter.
             self.weights = self._placed(self.weights, sharded=True)
@@ -186,11 +212,28 @@ class Federation:
 
     def _ensure_device_data(self):
         if self._device_data is None:
-            # Dataset replicated (every device gathers its own clients'
-            # batches locally); assignment matrix sharded by client. Images
-            # live FLAT ([N, H*W*C]): NHWC tensors pad ~4x under TPU tiled
-            # layouts, flat rows tile exactly — the per-batch reshape after
-            # the gather is free.
+            if self._layout == "presharded":
+                # Per-client contiguous rows ([n, 2L, F], see
+                # fedtpu.data.device.preshard_arrays) — sharded by CLIENT on
+                # a mesh, so each device stores only its own clients' data.
+                from fedtpu.data.device import preshard_arrays
+
+                xs_c, ys_c = preshard_arrays(
+                    self.images, self.labels, self.client_idx,
+                    self.client_mask,
+                )
+                self._device_data = (
+                    self._placed(xs_c, sharded=True),
+                    self._placed(ys_c, sharded=True),
+                    self._placed(self.client_idx, sharded=True),
+                    self._placed(self.client_mask, sharded=True),
+                )
+                return self._device_data
+            # Gather layout: dataset replicated (every device gathers its own
+            # clients' batches locally); assignment matrix sharded by client.
+            # Images live FLAT ([N, H*W*C]): NHWC tensors pad ~4x under TPU
+            # tiled layouts, flat rows tile exactly — the per-batch reshape
+            # after the gather is free.
             flat = np.asarray(self.images, np.float32).reshape(
                 len(self.images), -1
             )
@@ -335,7 +378,7 @@ class Federation:
                     make_multi_round_step(
                         self.model, self.cfg, self._steps, num_rounds,
                         self._compressor, shuffle=self._shuffle,
-                        image_shape=self._img_shape,
+                        image_shape=self._img_shape, layout=self._layout,
                     ),
                     donate_argnums=(0,),
                 )
@@ -345,7 +388,7 @@ class Federation:
                 self._multi_steps[num_rounds] = make_sharded_multi_round_step(
                     self.model, self.cfg, self._steps, num_rounds, self.mesh,
                     self._compressor, shuffle=self._shuffle,
-                    image_shape=self._img_shape,
+                    image_shape=self._img_shape, layout=self._layout,
                 )
         return self._multi_steps[num_rounds]
 

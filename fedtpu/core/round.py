@@ -356,14 +356,20 @@ def make_round_step(
     (:class:`fedtpu.ops.compression.Compressor`) — the ``-c Y`` parity path;
     its error-feedback residuals ride in ``state.comp_state``.
 
-    With ``stream=True`` the returned function is
-    ``round_step(state, batch, images, labels)`` where ``batch.x`` holds
-    int32 gather indices ``[clients, steps, batch]`` into the device-resident
-    dataset (``batch.y`` is ignored); each scan step gathers only its own
-    batch, so nothing ``[clients, steps, batch, ...]``-sized is ever
-    materialised — see :mod:`fedtpu.data.device`.
+    With ``stream`` set the returned function is
+    ``round_step(state, batch, images, labels)`` and each scan step extracts
+    only its own batch, so nothing ``[clients, steps, batch, ...]``-sized is
+    ever materialised — see :mod:`fedtpu.data.device`. Two stream forms:
+    ``"gather"`` (alias ``True``): ``batch.x`` holds int32 gather indices
+    ``[clients, steps, batch]`` into the flat dataset (``batch.y`` ignored);
+    ``"presharded"``: ``images``/``labels`` are the per-client
+    ``[clients, 2L, ...]`` presharded arrays and ``batch.x`` holds per-step
+    slice offsets ``[clients, steps]``.
     """
     from fedtpu.core import server_opt as server_opt_lib
+
+    if stream is True:
+        stream = "gather"
 
     if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean", "krum"):
         raise ValueError(
@@ -408,7 +414,14 @@ def make_round_step(
     local_update = make_local_update(
         model.apply, cfg, stream=stream, image_shape=image_shape
     )
-    if stream:
+    if stream == "presharded":
+        # images/labels are per-client rows — vmapped, unlike the shared
+        # flat dataset of the gather form.
+        vmapped = jax.vmap(
+            local_update,
+            in_axes=(None, None, 0, 0, 0, 0, 0, 0, None),
+        )
+    elif stream:
         vmapped = jax.vmap(
             local_update,
             in_axes=(None, None, 0, None, None, 0, 0, 0, None),

@@ -182,11 +182,14 @@ def compile_round_step(
     }
 
 
-def _data_path_inputs(dev, cfg, model, total, num_rounds=None):
+def _data_path_inputs(dev, cfg, model, total, num_rounds=None,
+                      layout="presharded"):
     """ShapeDtypeStruct args for the device-resident data-path programs
-    (``make_data_round_step`` / ``make_multi_round_step``): flat dataset in
-    HBM, per-client assignment, weights/alive/key. ``num_rounds`` switches
-    ``alive`` to the fused scan's ``[rounds, clients]`` layout."""
+    (``make_data_round_step`` / ``make_multi_round_step``): dataset in HBM
+    (per-client ``[n, 2L, F]`` presharded rows by default, flat ``[N, F]``
+    for the gather layout), per-client assignment, weights/alive/key.
+    ``num_rounds`` switches ``alive`` to the fused scan's
+    ``[rounds, clients]`` layout."""
     from fedtpu.core import round as round_lib
 
     state = jax.eval_shape(
@@ -209,10 +212,16 @@ def _data_path_inputs(dev, cfg, model, total, num_rounds=None):
         if num_rounds is None
         else sds((num_rounds, n), jnp.bool_)
     )
+    if layout == "presharded":
+        images = sds((n, 2 * shard, 32 * 32 * 3), jnp.float32)
+        labels = sds((n, 2 * shard), jnp.int32)
+    else:
+        images = sds((total, 32 * 32 * 3), jnp.float32)
+        labels = sds((total,), jnp.int32)
     return (
         place(state),
-        sds((total, 32 * 32 * 3), jnp.float32),  # flat dataset in HBM
-        sds((total,), jnp.int32),
+        images,
+        labels,
         sds((n, shard), jnp.int32),
         sds((n, shard), jnp.bool_),
         sds((n,), jnp.float32),
@@ -250,7 +259,7 @@ def compile_streaming_round_step(
         remat=remat,
     )
     model = models.create(cfg.model, num_classes=cfg.num_classes, remat=cfg.remat)
-    args = _data_path_inputs(dev, cfg, model, total=50000)
+    args = _data_path_inputs(dev, cfg, model, total=50000, layout="presharded")
     step_fn = jax.jit(
         make_data_round_step(
             model, cfg, steps, shuffle=True, stream=True,
@@ -307,8 +316,9 @@ def compile_fused_multi_round(
         dtype="bfloat16",
     )
     model = models.create(cfg.model, num_classes=cfg.num_classes)
-    multi_args = _data_path_inputs(dev, cfg, model, total, num_rounds=num_rounds)
-    single_args = _data_path_inputs(dev, cfg, model, total)
+    multi_args = _data_path_inputs(dev, cfg, model, total,
+                                   num_rounds=num_rounds, layout="presharded")
+    single_args = _data_path_inputs(dev, cfg, model, total, layout="presharded")
     multi = jax.jit(
         make_multi_round_step(
             model, cfg, steps, num_rounds, shuffle=True,
@@ -387,8 +397,8 @@ def compile_async_tick(
     shard = total // n
     args_ = (
         place(state),
-        sds((total, 32 * 32 * 3), jnp.float32),
-        sds((total,), jnp.int32),
+        sds((n, 2 * shard, 32 * 32 * 3), jnp.float32),  # presharded rows
+        sds((n, 2 * shard), jnp.int32),
         sds((n, shard), jnp.int32),
         sds((n, shard), jnp.bool_),
         sds((n,), jnp.float32),

@@ -177,6 +177,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # FEDTPU_PROFILE_TAG distinguishes re-measurements (e.g. the presharded
+    # data layout vs the r04 gather-layout baseline) without overwriting the
+    # earlier artifact.
+    tag = os.environ.get("FEDTPU_PROFILE_TAG", "r04")
     art = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                        "artifacts")
     os.makedirs(art, exist_ok=True)
@@ -184,7 +188,7 @@ def main():
               "num_clients": NUM_CLIENTS,
               "steps_per_round": STEPS_PER_ROUND,
               "configs": []}
-    profile_dir = os.path.join(art, "profile_r04")
+    profile_dir = os.path.join(art, f"profile_{tag}")
     for i, batch in enumerate(BATCHES):
         try:
             result["configs"].append(
@@ -195,7 +199,7 @@ def main():
             result["configs"].append({"batch": batch, "error": repr(exc)[:500]})
         # Persist incrementally: a tunnel re-wedge mid-sweep keeps the rows
         # measured so far.
-        out = os.path.join(art, "MFU_PROFILE_r04.json")
+        out = os.path.join(art, f"MFU_PROFILE_{tag}.json")
         tmp = out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=2)

@@ -89,38 +89,42 @@ def probe(timeout_s):
     return True, proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "ok"
 
 
-def _bench_job():
+def _bench_job(artifact="BENCH_LIVE_r04.json"):
     """Run bench.py; success = a JSON line with value > 0, saved as the live
     artifact (bench.py itself is already subprocess-isolated + bounded)."""
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=3600,
-    )
-    line = None
-    for cand in reversed(proc.stdout.strip().splitlines()):
-        cand = cand.strip()
-        if cand.startswith("{"):
-            try:
-                obj = json.loads(cand)
-            except ValueError:
-                continue
-            line = obj
-            break
-    if not line:
-        return False, f"no JSON from bench.py (rc={proc.returncode})"
-    if line.get("value", 0) <= 0:
-        return False, f"bench diagnostic: {line.get('error', line)}"
-    line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    line["captured_by"] = "tools/tpu_watch.py (round 4 watcher)"
-    atomic_write(os.path.join(ART, "BENCH_LIVE_r04.json"), json.dumps(line, indent=2))
-    return True, f"value={line['value']} {line.get('unit', '')} mfu={line.get('mfu')}"
-
-
-def _script_job(rel, timeout_s, artifact):
     def run():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=3600,
+        )
+        line = None
+        for cand in reversed(proc.stdout.strip().splitlines()):
+            cand = cand.strip()
+            if cand.startswith("{"):
+                try:
+                    obj = json.loads(cand)
+                except ValueError:
+                    continue
+                line = obj
+                break
+        if not line:
+            return False, f"no JSON from bench.py (rc={proc.returncode})"
+        if line.get("value", 0) <= 0:
+            return False, f"bench diagnostic: {line.get('error', line)}"
+        line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        line["captured_by"] = "tools/tpu_watch.py (round 4 watcher)"
+        atomic_write(os.path.join(ART, artifact), json.dumps(line, indent=2))
+        return True, f"value={line['value']} {line.get('unit', '')} mfu={line.get('mfu')}"
+    return run
+
+
+def _script_job(rel, timeout_s, artifact, env=None):
+    def run():
+        run_env = dict(os.environ, **(env or {}))
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, rel)],
             capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+            env=run_env,
         )
         ok = proc.returncode == 0 and os.path.exists(os.path.join(ART, artifact))
         tail = (proc.stderr or proc.stdout).strip()[-300:]
@@ -129,8 +133,16 @@ def _script_job(rel, timeout_s, artifact):
 
 
 JOBS = [
-    ("bench_fused", _bench_job),
+    # Presharded-layout re-measurements first: after the round-4 data-layout
+    # rework (fedtpu/data/device.py) these are the numbers that matter most,
+    # and windows are scarce.
+    ("bench_fused_presharded", _bench_job("BENCH_LIVE_r04_presharded.json")),
+    ("mfu_profile_presharded",
+     _script_job("tools/bench_profile_tpu.py", 2400,
+                 "MFU_PROFILE_r04_presharded.json",
+                 env={"FEDTPU_PROFILE_TAG": "r04_presharded"})),
     ("pallas_timing", _script_job("tools/run_pallas_tpu.py", 2400, "PALLAS_TPU_RUN.json")),
+    ("bench_fused", _bench_job()),
     ("mfu_profile", _script_job("tools/bench_profile_tpu.py", 2400, "MFU_PROFILE_r04.json")),
 ]
 

@@ -27,9 +27,10 @@ field) and exits 0 so the artifact is diagnostic rather than empty.
 
 The measured program is the engine's fused multi-round scan
 (:func:`fedtpu.data.device.make_multi_round_step`): each timed dispatch runs
-``TIMED_ROUNDS`` complete FedAvg rounds on device — per-round batch gather
-from the HBM-resident dataset, vmapped local SGD, aggregation — with no host
-involvement between rounds. Timing is honest under the remote-tunnel device:
+``TIMED_ROUNDS`` complete FedAvg rounds on device — per-round batch
+extraction from the HBM-resident presharded dataset (one contiguous rotated
+slice per round; see ``fedtpu/data/device.py``), vmapped local SGD,
+aggregation — with no host involvement between rounds. Timing is honest under the remote-tunnel device:
 the stacked per-round losses (program outputs) are fetched after every
 dispatch, which cannot complete before all rounds have executed
 (``block_until_ready`` alone does not reliably block on the tunnel); the

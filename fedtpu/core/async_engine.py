@@ -47,7 +47,6 @@ from fedtpu.config import RoundConfig
 from fedtpu.core import optim
 from fedtpu.core.client import ClientOutput, make_local_update
 from fedtpu.core.round import _mean_over_clients, init_state
-from fedtpu.data.device import round_take_indices
 from fedtpu.utils import trees
 
 Pytree = Any
@@ -204,6 +203,11 @@ def make_async_step(
                 images, labels, off, steps, batch_size, shape
             )
         else:
+            # Deferred import: fedtpu.data.device itself imports from
+            # fedtpu.core.round, so a module-level import here makes the
+            # package import-order sensitive (data.device first -> cycle).
+            from fedtpu.data.device import round_take_indices
+
             take = round_take_indices(idx, mask, need, rng)
             tail = shape if images.ndim == 2 else tuple(images.shape[1:])
             x = images[take].reshape((n, steps, batch_size) + tail)

@@ -32,8 +32,11 @@ from fedtpu.core.round import (
 )
 from fedtpu.core.client import make_eval_fn
 from fedtpu.data import data_source, dataset_info, load, partition
-from fedtpu.data.device import make_data_round_step
 from fedtpu.utils.metrics import MetricsLogger
+
+# NOTE: fedtpu.data.device imports from fedtpu.core.round, whose package
+# __init__ imports this module — so every data.device import below is
+# deferred to call time to keep the package import-order insensitive.
 
 
 class Federation:
@@ -164,6 +167,8 @@ class Federation:
                 layout = "gather"
         self._layout = layout
         if mesh is None:
+            from fedtpu.data.device import make_data_round_step
+
             self._round_step = jax.jit(
                 make_round_step(self.model, cfg, compressor), donate_argnums=(0,)
             )

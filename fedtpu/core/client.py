@@ -16,10 +16,10 @@ from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from fedtpu.config import RoundConfig
 from fedtpu.core import optim
+from fedtpu.ops.losses import softmax_ce_int_labels
 from fedtpu.utils import trees
 
 Pytree = Any
@@ -102,7 +102,7 @@ def make_local_update(
             rngs={"dropout": rng},
         )
         logits = logits.astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        ce = softmax_ce_int_labels(logits, y).mean()
         loss = ce
         if mu > 0.0:
             # FedProx proximal term: mu/2 * ||w - w_global||^2 keeps local
@@ -290,9 +290,7 @@ def make_eval_fn(apply_fn: Callable, cfg: RoundConfig) -> Callable:
     def eval_step(params, batch_stats, x, y):
         variables = {"params": params, "batch_stats": batch_stats}
         logits = apply_fn(variables, x, train=False, mutable=False)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), y
-        )
+        ce = softmax_ce_int_labels(logits.astype(jnp.float32), y)
         correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
         return ce.sum(), correct.sum()
 

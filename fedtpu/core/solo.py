@@ -18,11 +18,11 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from fedtpu import models as model_zoo
 from fedtpu.config import RoundConfig
 from fedtpu.core import optim
+from fedtpu.ops.losses import softmax_ce_int_labels
 from fedtpu.core.client import batch_eval_arrays, make_eval_fn
 from fedtpu.data import dataset_info, load
 from fedtpu.transport import wire
@@ -141,9 +141,7 @@ class SoloTrainer:
                 variables, x, train=True, mutable=["batch_stats"],
                 rngs={"dropout": rng},
             )
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), y
-            ).mean()
+            ce = softmax_ce_int_labels(logits.astype(jnp.float32), y).mean()
             acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
             return ce, (updated.get("batch_stats", batch_stats), acc)
 

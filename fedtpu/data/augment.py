@@ -18,18 +18,41 @@ def augment_batch(rng: jax.Array, x: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
     Divergence note: torchvision pads raw pixel 0 *before* normalisation
     (reference transform order, ``src/main.py:37-42``); here the pad is 0 in
     normalised space (≈ the mean pixel) — immaterial for accuracy parity.
+
+    Implementation is VPU-shaped on purpose. A per-example
+    ``vmap(dynamic_slice)`` crop lowers on XLA:TPU to a SERIAL per-example
+    slice loop — measured as ~250k ~2 us ops and the single largest consumer
+    of the fused-round dispatch on a real v5e chip
+    (``artifacts/MFU_PROFILE_r04_presharded.json``; the round-4 trace's
+    ``bitcast_dynamic-update-slice_fusion`` at n=248728). One-hot
+    selection-MATMULS are no better: a batch of 8192 tiny ``32x40 @ 40x120``
+    dots serializes the same way (measured 6x WORSE than the slice loop).
+    What vectorizes is shift-accumulate: a crop offset has only ``2*pad+1``
+    possible values per axis, so the crop is a weighted sum of the
+    ``2*pad+1`` STATIC slices of the padded tensor per axis — unrolled
+    elementwise FMAs with per-example one-hot weights, no gathers, no
+    matmuls, nothing data-dependent in the op graph. Output is bit-identical
+    to the slice formulation: exactly one term per sum has weight 1.0, the
+    rest contribute f32 ``0.0 * pixel = 0.0``, and adding zeros preserves
+    the value bit-for-bit.
     """
     n, h, w, c = x.shape
+    nshift = 2 * pad + 1
     crop_rng, flip_rng = jax.random.split(rng)
     padded = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
 
-    offs = jax.random.randint(crop_rng, (n, 2), 0, 2 * pad + 1)
+    offs = jax.random.randint(crop_rng, (n, 2), 0, nshift)
+    w_h = jax.nn.one_hot(offs[:, 0], nshift, dtype=x.dtype)  # [n, nshift]
+    w_w = jax.nn.one_hot(offs[:, 1], nshift, dtype=x.dtype)
 
-    def crop_one(img, off):
-        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
-
-    cropped = jax.vmap(crop_one)(padded, offs)
+    rows = sum(
+        w_h[:, s, None, None, None] * padded[:, s:s + h, :, :]
+        for s in range(nshift)
+    )
+    cropped = sum(
+        w_w[:, s, None, None, None] * rows[:, :, s:s + w, :]
+        for s in range(nshift)
+    )
 
     flip = jax.random.bernoulli(flip_rng, 0.5, (n,))
-    flipped = jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)
-    return flipped
+    return jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)

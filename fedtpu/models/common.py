@@ -9,10 +9,12 @@ stats alongside weights (``src/server.py:163-171``).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -50,7 +52,69 @@ def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
 
 def max_pool(x, window: int, stride: int | None = None, padding: str = "VALID"):
     stride = stride or window
+    if (
+        os.environ.get("FEDTPU_TILED_POOL", "0") == "1"
+        and stride == window
+        and padding == "VALID"
+        and x.ndim == 4
+        and x.shape[1] % window == 0
+        and x.shape[2] % window == 0
+    ):
+        return _tiled_max_pool(x, window)
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tiled_max_pool(x, k: int):
+    """Non-overlapping NHWC max-pool as transpose-free two-stage reshape+max.
+
+    ``nn.max_pool``'s gradient lowers to ``select_and_scatter``, which the
+    round-4 on-chip traces measured as the single largest op family in the
+    fused round dispatch (~34% at the bf16 bench config,
+    ``artifacts/MFU_PROFILE_r04_bf16.json``). OPT-IN via
+    ``FEDTPU_TILED_POOL=1`` and kept as a twice-measured NEGATIVE result:
+    despite that trace line, both reformulations LOST end-to-end on the
+    real chip (``moveaxis``-flattened windows: 399 vs 598
+    client-epochs/s/chip; this transpose-free two-stage version: 380 vs
+    598) — the custom VJP is opaque to XLA's fusion and its argmax
+    residuals add HBM traffic that ``select_and_scatter``, for all its op
+    time, does not pay. Here the windowed view ``[N, H/k, k, W/k, k, C]``
+    is a FREE reshape (row-major compatible); forward is
+    ``max`` over the two window axes in turn, and the custom VJP routes the
+    cotangent with one-hot ``argmax`` masks per stage. Two-stage first-max
+    composes to FIRST max in row-major window order — the row holding the
+    window max is the first row whose row-max equals it — matching both
+    ``select_and_scatter`` and torch's ``MaxPool2d`` at ties (common right
+    after ReLU), so forward AND backward are bit-identical to the
+    ``nn.max_pool`` formulation.
+    """
+    n, h, w, c = x.shape
+    return x.reshape(n, h // k, k, w // k, k, c).max(axis=(2, 4))
+
+
+def _tiled_max_pool_fwd(x, k: int):
+    n, h, w, c = x.shape
+    xw = x.reshape(n, h // k, k, w // k, k, c)
+    rowmax = xw.max(axis=4)                      # [n, h/k, k, w/k, c]
+    colidx = jnp.argmax(xw, axis=4)
+    rowidx = jnp.argmax(rowmax, axis=2)          # [n, h/k, w/k, c]
+    return rowmax.max(axis=2), (rowidx, colidx, x.shape)
+
+
+def _tiled_max_pool_bwd(k: int, res, g):
+    rowidx, colidx, (n, h, w, c) = res
+    win = jnp.arange(k, dtype=rowidx.dtype)
+    zero = jnp.zeros((), g.dtype)
+    # Stage 1: route g to the selected row of each window.
+    rmask = win[None, None, :, None, None] == rowidx[:, :, None, :, :]
+    g_row = jnp.where(rmask, g[:, :, None, :, :], zero)  # [n,h/k,k,w/k,c]
+    # Stage 2: route each row's share to its selected column.
+    cmask = win[None, None, None, None, :, None] == colidx[:, :, :, :, None, :]
+    g_xw = jnp.where(cmask, g_row[:, :, :, :, None, :], zero)
+    return (g_xw.reshape(n, h, w, c),)
+
+
+_tiled_max_pool.defvjp(_tiled_max_pool_fwd, _tiled_max_pool_bwd)
 
 
 def avg_pool(x, window: int, stride: int | None = None, padding: str = "VALID"):

@@ -10,9 +10,11 @@ with the per-example crop gather, dominating the fused-round dispatch.
 
 The one-hot contraction below computes the same value as a dense reduction
 (VPU/MXU-friendly, fuses into the log-softmax) and its backward is a dense
-broadcast instead of a scatter. Exactness: the label term is
-``1.0 * logp[label] + 0.0 * rest``, and adding f32 zeros preserves the value
-bit-for-bit, so results are bit-identical to the gather formulation.
+broadcast instead of a scatter. Exactness: the selection itself is exact
+(``1.0 * logp[label] + 0.0 * rest``; adding f32 zeros preserves bits), so
+any deviation from the gather formulation comes only from softmax
+accumulation order — measured <= 5e-10 on f32 gradients, 1e-6 on the
+forward (pinned in ``tests/test_tpu_formulations.py``).
 
 Parity: the loss itself matches the reference's ``nn.CrossEntropyLoss()``
 (`/root/reference/src/main.py:77`).

@@ -1,5 +1,14 @@
 """Pallas TPU kernels for the update-compression hot path.
 
+MEASURED VERDICT (round 4, real v5e chip — `artifacts/PALLAS_TPU_RUN.json`):
+XLA's automatic fusion **matches or beats** both kernels at MobileNet scale
+(`threshold_with_feedback`: Mosaic 0.155 ms vs XLA 0.101 ms;
+`quantdequant_int8`: 71.8 vs 71.1 ms; outputs bitwise-equal both ways). The
+kernels stay in the tree as the repo's documented Pallas on-ramp and as a
+pinned-fusion fallback should a future surrounding program defeat XLA's
+fusion heuristics — NOT as a performance claim. They are correct, tested,
+and AOT-compile for v5e; the plain-XLA path is the default.
+
 The compression pipeline (threshold mask, residual split, quantize — see
 :mod:`fedtpu.ops.compression`) is a chain of elementwise ops over every
 parameter of every client: at 64 clients x ~3.2M params (MobileNet, reference

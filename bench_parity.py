@@ -201,6 +201,33 @@ def acc_configs():
              "cifar100_hard", 2, 64, 4, local_epochs=5)
 
 
+def acc_full_configs():
+    """Config 4 at a sizing whose curves actually climb — runnable when a
+    REAL accelerator is live for the fedtpu side (the XLA:CPU fallback costs
+    30-60 s per resnet18 batch; on a v5e the whole run is seconds of device
+    time). The torch side stays on CPU where oneDNN convs are ~30x XLA:CPU
+    (BASELINE.md kernel-gap note): 4 clients x 4 batches x 5 epochs x 12
+    rounds = 960 batch-32 steps, ~20-40 min on this 1-core host."""
+
+    def mk4(name, clients, ex_per_client, rounds):
+        steps = max(1, math.ceil(ex_per_client / 32))
+        return name, RoundConfig(
+            model="resnet18",
+            num_classes=100,
+            opt=OptimizerConfig(learning_rate=0.05, schedule="constant"),
+            data=DataConfig(
+                dataset="cifar100_hard", batch_size=32, partition="iid",
+                num_examples=ex_per_client * clients, augment=False,
+                device_layout="gather",
+            ),
+            fed=FedConfig(num_clients=clients, num_rounds=rounds,
+                          local_epochs=5),
+            steps_per_round=steps,
+        )
+
+    yield mk4("4_accfull_resnet18_cifar100h_4c_5ep", 4, 128, 12)
+
+
 def run_one(name: str, cfg: RoundConfig, curve_out=None) -> dict:
     """``curve_out``: open file — appends one JSON line per round with the
     global model's test accuracy (per-round eval parity,
@@ -254,6 +281,10 @@ def main():
     p.add_argument("--acc-scale", action="store_true",
                    help="accuracy/convergence parity at the SPECIFIED conv "
                    "models (configs 2-4) on the non-saturating *_hard tasks")
+    p.add_argument("--acc-full", action="store_true",
+                   help="config 4 (resnet18/cifar100_hard, 5 local epochs) "
+                   "at climbing-curve sizing; fedtpu side wants a live "
+                   "accelerator (platform NOT pinned to cpu)")
     p.add_argument("--curve-out", default=None,
                    help="append per-round test-acc JSONL rows to this file")
     p.add_argument("--only", default=None,
@@ -268,8 +299,12 @@ def main():
     if args.platform is None and (args.quick or args.cpu_scale or args.acc_scale):
         args.platform = "cpu"
     apply_platform_flag(args)
-    gen = acc_configs() if args.acc_scale else configs(
-        args.quick, cpu_scale=args.cpu_scale)
+    if args.acc_full:
+        gen = acc_full_configs()
+    elif args.acc_scale:
+        gen = acc_configs()
+    else:
+        gen = configs(args.quick, cpu_scale=args.cpu_scale)
     curve = open(args.curve_out, "a") if args.curve_out else None
     try:
         for name, cfg in gen:

@@ -494,6 +494,9 @@ def main():
                    help="run bench_parity's accuracy-parity configs (the "
                    "specified conv models on the non-saturating *_hard "
                    "tasks) instead of the --cpu-scale speed configs")
+    p.add_argument("--acc-full", action="store_true",
+                   help="bench_parity's --acc-full config 4 sizing "
+                   "(climbing-curve resnet18/cifar100_hard)")
     p.add_argument("--curve-out", default=None,
                    help="append per-round test-acc JSONL rows to this file")
     args = p.parse_args()
@@ -505,8 +508,12 @@ def main():
         "3_acc_fedprox_smallcnn_cifar10h_32c": "reference has no FedProx; baseline is its plain FedAvg",
         "5_topk_compressed_fedavg_128c": "reference -c Y == transport gzip (no top-k)",
     }
-    gen = (bench_parity.acc_configs() if args.acc_scale
-           else bench_parity.configs(quick=False, cpu_scale=True))
+    if args.acc_full:
+        gen = bench_parity.acc_full_configs()
+    elif args.acc_scale:
+        gen = bench_parity.acc_configs()
+    else:
+        gen = bench_parity.configs(quick=False, cpu_scale=True)
     curve = open(args.curve_out, "a") if args.curve_out else None
     try:
         for name, cfg in gen:
@@ -514,7 +521,7 @@ def main():
                 continue
             print(json.dumps(
                 run_config(name, cfg, notes.get(name, ""), curve_out=curve,
-                           engine_partition=args.acc_scale)
+                           engine_partition=args.acc_scale or args.acc_full)
             ), flush=True)
     finally:
         if curve is not None:

@@ -10,11 +10,17 @@ Two HBM layouts (``DataConfig.device_layout``):
 * ``"presharded"`` (default): the dataset is reorganised ONCE at upload into
   ``[clients, 2*shard_len, features]`` (:func:`preshard_arrays`), so each
   round's batches are ONE contiguous ``dynamic_slice`` at a per-round
-  rotation offset. This exists because the gather layout was measured to
-  dominate the fused round on real TPU hardware: XLA:TPU lowers a
-  computed-index row-gather into a serial ~2 us dynamic-slice loop per row
-  (~250k ops and ~80% of the dispatch at the 64-client CIFAR bench —
-  round-4 trace, ``artifacts/MFU_PROFILE_r04.json``).
+  rotation offset. XLA:TPU lowers a computed-index row-gather into a serial
+  ~2 us dynamic-slice loop per row, so the layout converts per-round data
+  extraction from O(rows) serial ops to one DMA. Attribution honesty
+  (round-4 trace history): the first trace blamed the batch gather for ~80%
+  of the fused dispatch, but re-measuring after this layout shipped moved
+  the bench only 246→250 client-epochs/s/chip — the dominant serial loop
+  was actually the per-example augmentation crop + CE label gather (fixed
+  in ``fedtpu/data/augment.py`` / ``fedtpu/ops/losses.py``; see
+  ``artifacts/MFU_PROFILE_r04*.json`` and BASELINE.md). Presharded remains
+  the default for the DMA-shaped extraction, the per-client sharding under
+  ``shard_map``, and the bf16 residency it composes with.
 * ``"gather"``: dataset stays ``[N, features]``; per-round index gather.
   Exact per-round permutation shuffling and no 2x data HBM, at the measured
   gather cost. This is the exact semantics of the rounds-1-3 artifacts.

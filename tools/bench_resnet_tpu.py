@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Fused-round bench at the MXU-shaped config: resnet18/cifar100, 64 clients.
+
+BASELINE.md's attribution of the smallcnn bench's 1.31% MFU ends with "the
+right lever for MFU at fixed parity is a bigger model"; this measures that
+claim on a real chip. Same engine program as ``bench.py`` (the fused
+multi-round scan) at BASELINE config 4's model/dataset with
+``RoundConfig(remat=True)`` (per-block remat + per-step streaming slices —
+the single-chip-feasible form AOT-proven in ``PALLAS_TPU_COMPILE.json``).
+
+Writes ``artifacts/BENCH_RESNET_TPU.json`` and prints one JSON line. The
+whole measurement runs in a bounded subprocess (the tunnel can wedge
+mid-compile — observed 2026-07-31: a >60 min hang with no output); on
+timeout the artifact records the failure instead of hanging the watcher.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+OUT = os.path.join(ART, "BENCH_RESNET_TPU.json")
+TIMEOUT_S = 2700
+
+_INNER = r"""
+import json, time, sys
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, %(repo)r)
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core.engine import Federation
+
+NUM_CLIENTS=64; BATCH=128; STEPS=6; ROUNDS=2; TRIALS=3
+cfg = RoundConfig(model="resnet18", num_classes=100, opt=OptimizerConfig(),
+    data=DataConfig(dataset="cifar100", batch_size=BATCH, partition="iid",
+                    num_examples=NUM_CLIENTS*STEPS*BATCH),
+    fed=FedConfig(num_clients=NUM_CLIENTS), steps_per_round=STEPS,
+    dtype="bfloat16", remat=True)
+fed = Federation(cfg, seed=0)
+d = fed._ensure_device_data()
+alive = jnp.ones((ROUNDS, NUM_CLIENTS), bool)
+multi = fed._multi_step(ROUNDS)
+print("compiling...", flush=True)
+t0=time.time()
+step = multi.lower(fed.state, *d, fed.weights, alive, fed._data_key).compile()
+print("compiled in %%.1fs" %% (time.time()-t0), flush=True)
+flops = None
+try:
+    single = fed._data_step.lower(fed.state, *d, fed.weights,
+        jnp.ones((NUM_CLIENTS,), bool), fed._data_key).compile()
+    an = single.cost_analysis()
+    if isinstance(an,(list,tuple)): an = an[0] if an else {}
+    flops = float(an.get("flops",0.0)) or None
+except Exception as e:
+    print("cost analysis failed:", e, flush=True)
+state = fed.state
+state, m = step(state, *d, fed.weights, alive, fed._data_key)
+np.asarray(m.loss)  # warmup + honest sync
+rates=[]
+for _ in range(TRIALS):
+    t0=time.perf_counter()
+    state, m = step(state, *d, fed.weights, alive, fed._data_key)
+    np.asarray(m.loss)
+    rates.append(ROUNDS/(time.perf_counter()-t0))
+rps = sorted(rates)[len(rates)//2]
+kind = jax.devices()[0].device_kind
+out = {"metric":"fedavg_rounds_per_sec_cifar100_resnet18_64clients_1chip",
+  "rounds_per_sec": round(rps,4),
+  "client_epochs_per_sec_per_chip": round(rps*NUM_CLIENTS,2),
+  "num_clients":NUM_CLIENTS,"batch":BATCH,"steps_per_round":STEPS,
+  "remat":True,"dtype":"bfloat16","device_kind":kind,
+  "backend":jax.default_backend()}
+if flops:
+    out["flops_per_round"]=flops
+    import bench
+    peak = bench._peak_for(kind)
+    if peak:
+        out["mfu"]=round(rps*flops/peak,4)
+print(json.dumps(out), flush=True)
+"""
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from jsontail import last_json_line
+
+    inner = _INNER % {"repo": REPO}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", inner], capture_output=True, text=True,
+            timeout=TIMEOUT_S, cwd=REPO,
+        )
+        out, err, note = proc.stdout, proc.stderr, None
+    except subprocess.TimeoutExpired as exc:
+        out = (exc.stdout or b"")
+        out = out.decode() if isinstance(out, bytes) else out
+        err, note = "", f"timeout after {TIMEOUT_S}s"
+    line = last_json_line(out)
+    if line is None:
+        line = {"metric": "fedavg_rounds_per_sec_cifar100_resnet18_64clients_1chip",
+                "value": 0.0,
+                "error": note or f"no JSON (rc={proc.returncode}): {err.strip()[-400:]}",
+                "progress": (out or "").strip().splitlines()[-3:]}
+    line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(line, f, indent=2)
+    os.replace(tmp, OUT)
+    print(json.dumps(line))
+    return 0 if "error" not in line else 4
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

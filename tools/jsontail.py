@@ -1,0 +1,23 @@
+"""Shared helper: salvage the last JSON-object line from a child's stdout.
+
+Child processes on the wedge-prone tunnel backend can die or hang AFTER
+printing their measurement (interpreter teardown, profiler shutdown), so
+every capture tool scans stdout backwards for the last parseable JSON line
+instead of trusting the exit code. One implementation, used by
+``tools/run_accfull_tpu.py``, ``tools/bench_resnet_tpu.py`` and
+``tools/tpu_watch.py`` (and mirroring ``bench.py``'s internal `_salvage_json`).
+"""
+
+import json
+
+
+def last_json_line(text):
+    """Last line of ``text`` that parses as a JSON object, or ``None``."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None

@@ -37,6 +37,8 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "artifacts")
 LOCK_PATH = os.path.join(REPO, ".tpu_access.lock")
@@ -97,16 +99,9 @@ def _bench_job(artifact="BENCH_LIVE_r04.json"):
             [sys.executable, os.path.join(REPO, "bench.py")],
             capture_output=True, text=True, timeout=3600,
         )
-        line = None
-        for cand in reversed(proc.stdout.strip().splitlines()):
-            cand = cand.strip()
-            if cand.startswith("{"):
-                try:
-                    obj = json.loads(cand)
-                except ValueError:
-                    continue
-                line = obj
-                break
+        from jsontail import last_json_line
+
+        line = last_json_line(proc.stdout)
         if not line:
             return False, f"no JSON from bench.py (rc={proc.returncode})"
         if line.get("value", 0) <= 0:
@@ -133,9 +128,13 @@ def _script_job(rel, timeout_s, artifact, env=None):
 
 
 JOBS = [
-    # Presharded-layout re-measurements first: after the round-4 data-layout
-    # rework (fedtpu/data/device.py) these are the numbers that matter most,
-    # and windows are scarce.
+    # Remaining round-4 wants (2026-07-31, after the 03:19-05:10 window
+    # captured everything else): the fedtpu side of parity config 4 at
+    # climbing-curve sizing, and the MXU-shaped resnet18 fused bench.
+    ("acc_full_fedtpu",
+     _script_job("tools/run_accfull_tpu.py", 3100, "PARITY_ACC_FULL.jsonl")),
+    ("resnet18_bench",
+     _script_job("tools/bench_resnet_tpu.py", 2800, "BENCH_RESNET_TPU.json")),
     ("bench_fused_presharded", _bench_job("BENCH_LIVE_r04_presharded.json")),
     ("mfu_profile_presharded",
      _script_job("tools/bench_profile_tpu.py", 2400,

@@ -69,7 +69,18 @@ def dryrun_multichip(n_devices: int, model: str = "smallcnn") -> None:
     stacked = fed.run_on_device(2)
     assert stacked.loss.shape == (2,)
     assert int(fed.state.round_idx) == 2
+
+    # And the bench's actual residency mode: bf16 compute with the device
+    # dataset stored in the compute dtype, presharded rows sharded by client
+    # over the mesh (round 4's perf path — engine._store_dtype).
+    import dataclasses
+
+    bf16 = dataclasses.replace(cfg, dtype="bfloat16")
+    fed16 = Federation(bf16, seed=0, mesh=mesh)
+    stacked16 = fed16.run_on_device(2)
+    assert stacked16.loss.shape == (2,)
     print(
         f"dryrun_multichip ok: {n_devices} devices, {n} clients, "
-        f"loss={float(metrics.loss):.4f}, fused2_loss={float(stacked.loss[-1]):.4f}"
+        f"loss={float(metrics.loss):.4f}, fused2_loss={float(stacked.loss[-1]):.4f}, "
+        f"bf16_fused2_loss={float(stacked16.loss[-1]):.4f}"
     )

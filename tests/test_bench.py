@@ -58,3 +58,23 @@ def test_peak_lookup_covers_observed_device_kinds(bench):
     assert bench._peak_for("TPU v5e") == 197e12
     assert bench._peak_for("TPU v4") == 275e12
     assert bench._peak_for("weird accelerator") is None
+
+
+def test_acc_full_config_shape(monkeypatch):
+    """The --acc-full harness mode must keep config 4's defining traits
+    (reference ``BASELINE.json`` config 4: resnet18, cifar100, 5 local
+    epochs) at the climbing-curve sizing both harnesses share — the torch
+    row in ``artifacts/PARITY_ACC_FULL.jsonl`` was measured against exactly
+    this shape, and a silent drift would desync the comparison."""
+    monkeypatch.syspath_prepend(".")
+    import bench_parity
+
+    (name, cfg), = list(bench_parity.acc_full_configs())
+    assert name == "4_accfull_resnet18_cifar100h_4c_5ep"
+    assert cfg.model == "resnet18"
+    assert cfg.num_classes == 100
+    assert cfg.data.dataset == "cifar100_hard"
+    assert cfg.fed.local_epochs == 5
+    assert cfg.fed.num_clients == 4
+    assert cfg.fed.num_rounds == 12
+    assert cfg.data.device_layout == "gather"  # committed-artifact semantics

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Summarise the accuracy-parity artifacts into BASELINE.md-ready text.
 
-Reads ``artifacts/PARITY_ACC_CONV.jsonl`` (summary rows from both systems)
-and ``artifacts/convergence_hard_r04.jsonl`` (per-round test-acc curves) and
-prints: a markdown table pairing fedtpu vs reference per config, and a
-compact per-config curve digest (first / takeoff / final accuracy) showing
-both systems' dynamics side by side.
+Reads ``artifacts/PARITY_ACC_CONV.jsonl`` + ``PARITY_ACC_FULL.jsonl``
+(summary rows from both systems) and ``artifacts/convergence_hard_r04.jsonl``
++ ``convergence_full_r04.jsonl`` (per-round test-acc curves) and prints: a
+markdown table pairing fedtpu vs reference per config, and a compact
+per-config curve digest (first / takeoff / final accuracy) showing both
+systems' dynamics side by side.
 """
 
 import json
@@ -30,8 +31,10 @@ def _rows(path):
 
 
 def main():
-    summaries = _rows(os.path.join(ART, "PARITY_ACC_CONV.jsonl"))
-    curves = _rows(os.path.join(ART, "convergence_hard_r04.jsonl"))
+    summaries = (_rows(os.path.join(ART, "PARITY_ACC_CONV.jsonl"))
+                 + _rows(os.path.join(ART, "PARITY_ACC_FULL.jsonl")))
+    curves = (_rows(os.path.join(ART, "convergence_hard_r04.jsonl"))
+              + _rows(os.path.join(ART, "convergence_full_r04.jsonl")))
 
     by_cfg = defaultdict(dict)
     for r in summaries:

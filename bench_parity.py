@@ -207,25 +207,36 @@ def acc_full_configs():
     30-60 s per resnet18 batch; on a v5e the whole run is seconds of device
     time). The torch side stays on CPU where oneDNN convs are ~30x XLA:CPU
     (BASELINE.md kernel-gap note): 4 clients x 4 batches x 5 epochs x 12
-    rounds = 960 batch-32 steps, ~20-40 min on this 1-core host."""
+    rounds = 960 batch-32 steps, ~20-40 min on this 1-core host.
 
-    def mk4(name, clients, ex_per_client, rounds):
+    ``FEDTPU_SMOKE=1`` swaps in an MLP seconds-scale version of the same
+    shape so the capture wrapper (``tools/run_accfull_tpu.py``) can be
+    exercised end-to-end on CPU without burning a TPU window on a wrapper
+    bug; the wrapper redirects its artifacts when smoking."""
+
+    def mk4(name, model, classes, dataset, clients, ex_per_client, rounds,
+            local_epochs):
         steps = max(1, math.ceil(ex_per_client / 32))
         return name, RoundConfig(
-            model="resnet18",
-            num_classes=100,
+            model=model,
+            num_classes=classes,
             opt=OptimizerConfig(learning_rate=0.05, schedule="constant"),
             data=DataConfig(
-                dataset="cifar100_hard", batch_size=32, partition="iid",
+                dataset=dataset, batch_size=32, partition="iid",
                 num_examples=ex_per_client * clients, augment=False,
                 device_layout="gather",
             ),
             fed=FedConfig(num_clients=clients, num_rounds=rounds,
-                          local_epochs=5),
+                          local_epochs=local_epochs),
             steps_per_round=steps,
         )
 
-    yield mk4("4_accfull_resnet18_cifar100h_4c_5ep", 4, 128, 12)
+    if os.environ.get("FEDTPU_SMOKE"):
+        yield mk4("4_accfull_SMOKE_mlp", "mlp", 10, "cifar10_hard",
+                  2, 64, 3, 2)
+        return
+    yield mk4("4_accfull_resnet18_cifar100h_4c_5ep", "resnet18", 100,
+              "cifar100_hard", 4, 128, 12, 5)
 
 
 def run_one(name: str, cfg: RoundConfig, curve_out=None) -> dict:
@@ -296,7 +307,10 @@ def main():
     # Quick/cpu-scale/acc-scale modes are CPU workloads by definition; pin
     # the platform so a wedged remote TPU backend can't hang them at
     # jax.devices().
-    if args.platform is None and (args.quick or args.cpu_scale or args.acc_scale):
+    if args.platform is None and (
+        args.quick or args.cpu_scale or args.acc_scale
+        or (args.acc_full and os.environ.get("FEDTPU_SMOKE"))
+    ):
         args.platform = "cpu"
     apply_platform_flag(args)
     if args.acc_full:

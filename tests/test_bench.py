@@ -67,6 +67,7 @@ def test_acc_full_config_shape(monkeypatch):
     row in ``artifacts/PARITY_ACC_FULL.jsonl`` was measured against exactly
     this shape, and a silent drift would desync the comparison."""
     monkeypatch.syspath_prepend(".")
+    monkeypatch.delenv("FEDTPU_SMOKE", raising=False)
     import bench_parity
 
     (name, cfg), = list(bench_parity.acc_full_configs())

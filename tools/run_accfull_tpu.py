@@ -24,6 +24,11 @@ from jsontail import last_json_line  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "artifacts")
+if os.environ.get("FEDTPU_SMOKE"):
+    # Smoke mode (CPU, seconds): exercise the whole capture path — scratch
+    # curves, append-on-success — WITHOUT touching the committed artifacts.
+    ART = os.path.join("/tmp", "fedtpu_accfull_smoke")
+    os.makedirs(ART, exist_ok=True)
 ROWS = os.path.join(ART, "PARITY_ACC_FULL.jsonl")
 CURVES = os.path.join(ART, "convergence_full_r04.jsonl")
 TIMEOUT_S = 3000
@@ -33,11 +38,13 @@ def main():
     scratch = CURVES + ".inflight"
     if os.path.exists(scratch):
         os.remove(scratch)
+    cmd = [sys.executable, os.path.join(REPO, "bench_parity.py"),
+           "--acc-full", "--curve-out", scratch]
+    if os.environ.get("FEDTPU_SMOKE"):
+        cmd += ["--platform", "cpu"]  # smoke must not touch a wedged tunnel
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench_parity.py"),
-             "--acc-full", "--curve-out", scratch],
-            capture_output=True, text=True, timeout=TIMEOUT_S, cwd=REPO,
+            cmd, capture_output=True, text=True, timeout=TIMEOUT_S, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
         print(json.dumps({"error": f"timeout after {TIMEOUT_S}s"}))

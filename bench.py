@@ -23,7 +23,9 @@ Robustness: backend acquisition on the remote-tunnel TPU can wedge (observed:
 bare ``jax.devices()`` hanging >120 s), so the measurement runs in a child
 process with a bounded timeout and is retried with backoff; on terminal
 failure this script STILL prints exactly one JSON line (with an ``error``
-field) and exits 0 so the artifact is diagnostic rather than empty.
+field, plus a ``live_artifact`` pointer to this round's most recent
+builder-captured live measurement if one exists) and exits 0 so the
+artifact is diagnostic rather than empty.
 
 The measured program is the engine's fused multi-round scan
 (:func:`fedtpu.data.device.make_multi_round_step`): each timed dispatch runs
@@ -200,6 +202,44 @@ def _measure():
     return result
 
 
+def _live_artifact_pointer():
+    """Most recent builder-captured live measurement, if any — attached to
+    DIAGNOSTIC (value 0.0) outputs only, so a wedged-tunnel bench moment
+    still records where this round's measured number lives. Never used as
+    the reported value: the driver's number must be the driver's run."""
+    art = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
+    best = None
+    try:
+        names = sorted(os.listdir(art))
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("BENCH_LIVE_") and name.endswith(".json")):
+            continue
+        # Per-file guard: a capture killed mid-write (the wedge scenario this
+        # pointer exists for) can leave one truncated artifact — skip it, do
+        # not lose the pointer to the valid ones.
+        try:
+            with open(os.path.join(art, name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and data.get("value", 0) > 0:
+            stamp = data.get("captured_at") or ""
+            if best is None or stamp >= best[2]:
+                best = (name, data, stamp)
+    if best is None:
+        return None
+    name, data, _ = best
+    return {
+        "live_artifact": f"artifacts/{name}",
+        "live_value": data.get("value"),
+        "live_unit": data.get("unit"),
+        "live_captured_at": data.get("captured_at"),
+        "live_device_kind": data.get("device_kind"),
+    }
+
+
 def _salvage_json(text: str):
     """Last line of ``text`` that parses as a JSON object, or None. Guards
     against truncated lines from a killed child being shipped as the
@@ -250,18 +290,16 @@ def main():
 
     ok, detail = _backend_reachable()
     if not ok:
-        print(
-            json.dumps(
-                {
-                    "metric": METRIC,
-                    "value": 0.0,
-                    "unit": UNIT,
-                    "vs_baseline": 0.0,
-                    "error": f"backend unreachable: {detail}",
-                    "backend": os.environ.get("JAX_PLATFORMS", "default"),
-                }
-            )
-        )
+        diag = {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "error": f"backend unreachable: {detail}",
+            "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        }
+        diag.update(_live_artifact_pointer() or {})
+        print(json.dumps(diag))
         return
 
     last_err = "unknown"
@@ -296,18 +334,16 @@ def main():
             f"attempt {attempt + 1}: rc={proc.returncode}, no JSON: "
             + proc.stderr.strip()[-1500:]
         )
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": UNIT,
-                "vs_baseline": 0.0,
-                "error": last_err,
-                "backend": os.environ.get("JAX_PLATFORMS", "default"),
-            }
-        )
-    )
+    diag = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "error": last_err,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    diag.update(_live_artifact_pointer() or {})
+    print(json.dumps(diag))
 
 
 if __name__ == "__main__":

@@ -79,3 +79,20 @@ def test_acc_full_config_shape(monkeypatch):
     assert cfg.fed.num_clients == 4
     assert cfg.fed.num_rounds == 12
     assert cfg.data.device_layout == "gather"  # committed-artifact semantics
+
+
+def test_unreachable_diagnostic_carries_live_pointer(bench, monkeypatch, capsys):
+    """A wedged-tunnel bench moment must still record WHERE this round's
+    live-captured number lives (value stays honestly 0.0 — the driver's
+    number must be the driver's run)."""
+    monkeypatch.setattr(bench, "_backend_reachable", lambda: (False, "probe timed out"))
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    import json
+
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert "backend unreachable" in out["error"]
+    # This repo has committed live artifacts; the pointer must surface one.
+    assert out["live_artifact"].startswith("artifacts/BENCH_LIVE_")
+    assert out["live_value"] > 0

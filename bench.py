@@ -217,17 +217,19 @@ def _live_artifact_pointer():
         if not (name.startswith("BENCH_LIVE_") and name.endswith(".json")):
             continue
         # Per-file guard: a capture killed mid-write (the wedge scenario this
-        # pointer exists for) can leave one truncated artifact — skip it, do
-        # not lose the pointer to the valid ones.
+        # pointer exists for) can leave one truncated artifact, and nothing
+        # stops a writer emitting null/odd-typed fields — skip such files,
+        # never lose the pointer to the valid ones.
         try:
             with open(os.path.join(art, name)) as f:
                 data = json.load(f)
-        except (OSError, ValueError):
-            continue
-        if isinstance(data, dict) and data.get("value", 0) > 0:
-            stamp = data.get("captured_at") or ""
+            if not (isinstance(data, dict) and data.get("value", 0) > 0):
+                continue
+            stamp = str(data.get("captured_at") or "")
             if best is None or stamp >= best[2]:
                 best = (name, data, stamp)
+        except (OSError, ValueError, TypeError):
+            continue
     if best is None:
         return None
     name, data, _ = best
@@ -283,6 +285,20 @@ def _backend_reachable():
     return False, f"{PROBE_ATTEMPTS} attempts; last: {last}"
 
 
+def _print_diag(error: str) -> None:
+    """Emit the value-0.0 diagnostic line (with the live-artifact pointer)."""
+    diag = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "error": error,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    diag.update(_live_artifact_pointer() or {})
+    print(json.dumps(diag))
+
+
 def main():
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))
@@ -290,16 +306,7 @@ def main():
 
     ok, detail = _backend_reachable()
     if not ok:
-        diag = {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": UNIT,
-            "vs_baseline": 0.0,
-            "error": f"backend unreachable: {detail}",
-            "backend": os.environ.get("JAX_PLATFORMS", "default"),
-        }
-        diag.update(_live_artifact_pointer() or {})
-        print(json.dumps(diag))
+        _print_diag(f"backend unreachable: {detail}")
         return
 
     last_err = "unknown"
@@ -334,16 +341,7 @@ def main():
             f"attempt {attempt + 1}: rc={proc.returncode}, no JSON: "
             + proc.stderr.strip()[-1500:]
         )
-    diag = {
-        "metric": METRIC,
-        "value": 0.0,
-        "unit": UNIT,
-        "vs_baseline": 0.0,
-        "error": last_err,
-        "backend": os.environ.get("JAX_PLATFORMS", "default"),
-    }
-    diag.update(_live_artifact_pointer() or {})
-    print(json.dumps(diag))
+    _print_diag(last_err)
 
 
 if __name__ == "__main__":

@@ -66,6 +66,13 @@ class Federation:
         (one chip, or tests)."""
         self.cfg = cfg
         self.mesh = mesh
+        # Persistent XLA compile cache: on the wedge-prone remote-tunnel TPU
+        # a large program's compile can outlive the tunnel window that
+        # started it; caching at the engine layer covers every entrypoint
+        # (bench tools, CLIs, harnesses) without a per-script checklist.
+        from fedtpu.utils.platform import enable_compile_cache
+
+        enable_compile_cache()
         # Config validation FIRST — a bad flag must not cost a model build,
         # a dataset load, and jit construction before raising.
         if cfg.fed.participation_sampling not in ("uniform", "loss"):

@@ -21,25 +21,23 @@ def enable_compile_cache(path: Optional[str] = None) -> None:
     recompiling from scratch. Safe to call before or after backend init;
     no-op on failure (older jax without the config).
 
-    Skipped when the platform is pinned to CPU (config or ``JAX_PLATFORMS``)
-    unless ``path`` is given explicitly: caching only pays on the wedge-prone
-    accelerator, and XLA:CPU AOT reload warns about host machine-feature
+    Skipped when the ACTIVE backend is CPU, unless ``path`` or
+    ``FEDTPU_COMPILE_CACHE`` opts in explicitly: caching only pays on
+    accelerators, and XLA:CPU AOT reload warns about host machine-feature
     mismatches ("could lead to SIGILL") — not a risk worth taking to save
-    seconds-scale CPU compiles in tests."""
+    seconds-scale CPU compiles in tests. Deciding on the real backend
+    (``jax.default_backend()``) rather than the pin strings keeps a
+    ``cuda,cpu`` fallback list cached and an unpinned CPU-only box safe;
+    callers (the engine) are about to touch the backend anyway, so this
+    introduces no new hang point on a wedged tunnel."""
     try:
         import jax
 
-        if path is None:
-            pinned = (
-                getattr(jax.config, "jax_platforms", None)
-                or os.environ.get("JAX_PLATFORMS", "")
-                or ""
-            )
-            if pinned and "cpu" in pinned and "tpu" not in pinned:
-                return
-        cache = path or os.environ.get(
-            "FEDTPU_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "fedtpu-xla"),
+        explicit = path or os.environ.get("FEDTPU_COMPILE_CACHE")
+        if not explicit and jax.default_backend() == "cpu":
+            return
+        cache = explicit or os.path.join(
+            os.path.expanduser("~"), ".cache", "fedtpu-xla"
         )
         os.makedirs(cache, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache)

@@ -1,0 +1,123 @@
+"""Unit tests for the TPU-window watcher's capture bookkeeping.
+
+`tools/tpu_watch.py` guards a scarce resource: live tunnel windows open
+rarely and every mis-fire (re-running a captured job, clobbering a sibling
+watcher's done-list, continuing after the tunnel re-wedges) burns minutes
+of the only hardware access the round gets. These tests pin the state
+machine with stubbed jobs — no TPU, no subprocesses.
+"""
+
+import importlib
+import os
+
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def watch(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(_REPO_ROOT, "tools"))
+    import tpu_watch as mod
+
+    mod = importlib.reload(mod)
+    # Redirect every filesystem touchpoint into the sandbox.
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    monkeypatch.setattr(mod, "ART", str(tmp_path / "artifacts"))
+    monkeypatch.setattr(mod, "STATE_PATH", str(tmp_path / "state.json"))
+    monkeypatch.setattr(mod, "LOCK_PATH", str(tmp_path / "lock"))
+    (tmp_path / "artifacts").mkdir()
+    (tmp_path / "tools").mkdir()
+    return mod
+
+
+def _lock(watch):
+    return open(watch.LOCK_PATH, "w")
+
+
+def test_run_pending_skips_done_and_records_success(watch, monkeypatch):
+    calls = []
+
+    def ok_job(name):
+        def run():
+            calls.append(name)
+            return True, "fine"
+        return run
+
+    monkeypatch.setattr(watch, "JOBS", [("a", ok_job("a")), ("b", ok_job("b"))])
+    state = {"done": ["a"], "history": []}
+    watch.save_state(state)
+    assert watch.run_pending(state, _lock(watch)) is True
+    assert calls == ["b"]  # 'a' was already captured — never re-fired
+    assert set(state["done"]) == {"a", "b"}
+    # Persisted for a restarted watcher.
+    assert set(watch.load_state()["done"]) == {"a", "b"}
+
+
+def test_run_pending_stops_on_first_failure(watch, monkeypatch):
+    calls = []
+    monkeypatch.setattr(watch, "JOBS", [
+        ("a", lambda: (calls.append("a"), (False, "tunnel dropped"))[1]),
+        ("b", lambda: (calls.append("b"), (True, "fine"))[1]),
+    ])
+    state = {"done": [], "history": []}
+    assert watch.run_pending(state, _lock(watch)) is False
+    # A failed job means the tunnel likely re-wedged: later jobs must NOT
+    # burn what's left of the window.
+    assert calls == ["a"]
+    assert state["done"] == []
+    assert state["history"][-1]["ok"] is False
+
+
+def test_run_pending_survives_job_exception(watch, monkeypatch):
+    def boom():
+        raise RuntimeError("child machinery exploded")
+
+    monkeypatch.setattr(watch, "JOBS", [("a", boom)])
+    state = {"done": [], "history": []}
+    assert watch.run_pending(state, _lock(watch)) is False
+    assert "exception" in state["history"][-1]["detail"]
+
+
+def test_run_pending_merges_sibling_watchers_done_list(watch, monkeypatch):
+    # Another watcher captured 'a' while we blocked on the lock: the
+    # post-lock reload must absorb its done-list so we only run 'b', and
+    # saving must not clobber 'a'.
+    watch.save_state({"done": ["a"], "history": [{"job": "a", "ok": True}]})
+    calls = []
+    monkeypatch.setattr(watch, "JOBS", [
+        ("a", lambda: (calls.append("a"), (True, ""))[1]),
+        ("b", lambda: (calls.append("b"), (True, ""))[1]),
+    ])
+    state = {"done": [], "history": []}  # stale pre-lock snapshot
+    assert watch.run_pending(state, _lock(watch)) is True
+    assert calls == ["b"]
+    persisted = watch.load_state()
+    assert set(persisted["done"]) == {"a", "b"}
+    assert {"job": "a", "ok": True} in persisted["history"]
+
+
+def test_state_roundtrip_tolerates_missing_and_corrupt(watch, tmp_path):
+    # Missing file -> clean slate.
+    assert watch.load_state() == {"done": [], "history": []}
+    # Corrupt file (watcher killed mid-write happens; writes are atomic via
+    # os.replace, but a foreign writer might not be) -> clean slate, no raise.
+    (tmp_path / "state.json").write_text("{truncated")
+    assert watch.load_state() == {"done": [], "history": []}
+    watch.save_state({"done": ["x"], "history": []})
+    assert watch.load_state()["done"] == ["x"]
+
+
+def test_run_pending_skips_mfu_profile_when_script_missing(watch, monkeypatch):
+    # The mfu_profile job has an existence guard (the script landed
+    # mid-round once): missing script -> skipped this window, NOT failed,
+    # NOT marked done, and later jobs still run.
+    calls = []
+    monkeypatch.setattr(watch, "JOBS", [
+        ("mfu_profile", lambda: (calls.append("mfu"), (True, ""))[1]),
+        ("b", lambda: (calls.append("b"), (True, ""))[1]),
+    ])
+    state = {"done": [], "history": []}
+    assert watch.run_pending(state, _lock(watch)) is True
+    assert calls == ["b"]
+    assert state["done"] == ["b"]

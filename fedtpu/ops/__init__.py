@@ -12,6 +12,7 @@ from fedtpu.ops.compression import (
     make_topk,
     nnz_fraction,
 )
+from fedtpu.ops.losses import softmax_ce_int_labels
 
 __all__ = [
     "Compressor",
@@ -19,4 +20,5 @@ __all__ = [
     "make_int8",
     "make_topk",
     "nnz_fraction",
+    "softmax_ce_int_labels",
 ]

@@ -202,12 +202,15 @@ def _measure():
     return result
 
 
+ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
+
+
 def _live_artifact_pointer():
     """Most recent builder-captured live measurement, if any — attached to
     DIAGNOSTIC (value 0.0) outputs only, so a wedged-tunnel bench moment
     still records where this round's measured number lives. Never used as
     the reported value: the driver's number must be the driver's run."""
-    art = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
+    art = ARTIFACTS_DIR
     best = None
     try:
         names = sorted(os.listdir(art))

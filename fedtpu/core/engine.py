@@ -66,32 +66,14 @@ class Federation:
         (one chip, or tests)."""
         self.cfg = cfg
         self.mesh = mesh
-        # Persistent XLA compile cache: on the wedge-prone remote-tunnel TPU
-        # a large program's compile can outlive the tunnel window that
-        # started it; caching at the engine layer covers every entrypoint
-        # (bench tools, CLIs, harnesses) without a per-script checklist.
-        from fedtpu.utils.platform import enable_compile_cache
-
-        enable_compile_cache()
         # Config validation FIRST — a bad flag must not cost a model build,
-        # a dataset load, and jit construction before raising.
+        # a dataset load, jit construction, or even backend initialisation
+        # (enable_compile_cache touches the backend; on the wedge-prone
+        # tunnel that is a potential hang point) before raising.
         if cfg.fed.participation_sampling not in ("uniform", "loss"):
             raise ValueError(
                 f"unknown participation_sampling "
                 f"{cfg.fed.participation_sampling!r}; have uniform | loss"
-            )
-        if (
-            cfg.fed.participation_sampling == "loss"
-            and jax.process_count() > 1
-        ):
-            # Each controller builds its own alive mask from its own loss
-            # observations; per-process PARTIAL observations would diverge
-            # the masks (and thus the program inputs) across controllers.
-            raise ValueError(
-                "participation_sampling='loss' is single-controller only: "
-                "per-client losses are sharded across processes and partial "
-                "observations would desynchronise the sampling masks. Use "
-                "'uniform' on multi-controller deployments."
             )
         if cfg.data.device_layout not in ("presharded", "gather"):
             raise ValueError(
@@ -105,6 +87,32 @@ class Federation:
                 f"'{cfg.data.dataset}' has {n_classes} classes — set "
                 f"RoundConfig(num_classes={n_classes})"
             )
+        # This check is LAST among validations: jax.process_count() is the
+        # first backend touch, and every cheap string/shape error above must
+        # surface before any backend init (which can hang on a wedged
+        # tunnel).
+        if (
+            cfg.fed.participation_sampling == "loss"
+            and jax.process_count() > 1
+        ):
+            # Each controller builds its own alive mask from its own loss
+            # observations; per-process PARTIAL observations would diverge
+            # the masks (and thus the program inputs) across controllers.
+            raise ValueError(
+                "participation_sampling='loss' is single-controller only: "
+                "per-client losses are sharded across processes and partial "
+                "observations would desynchronise the sampling masks. Use "
+                "'uniform' on multi-controller deployments."
+            )
+        # Persistent XLA compile cache: on the wedge-prone remote-tunnel TPU
+        # a large program's compile can outlive the tunnel window that
+        # started it; caching at the engine layer covers every entrypoint
+        # (bench tools, CLIs, harnesses) without a per-script checklist.
+        # Deliberately AFTER the cheap validation above: it initialises the
+        # JAX backend, which an invalid config must never pay for.
+        from fedtpu.utils.platform import enable_compile_cache
+
+        enable_compile_cache()
         if cfg.fed.compression != "none" and compressor is None:
             from fedtpu.ops.compression import make_compressor
 

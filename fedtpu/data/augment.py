@@ -35,6 +35,12 @@ def augment_batch(rng: jax.Array, x: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
     to the slice formulation: exactly one term per sum has weight 1.0, the
     rest contribute f32 ``0.0 * pixel = 0.0``, and adding zeros preserves
     the value bit-for-bit.
+
+    Precondition: inputs must be FINITE. The zero-weight identity breaks on
+    non-finite pixels (``0.0 * inf = nan``), so a NaN/Inf anywhere in a
+    padded row window would corrupt neighboring outputs where the
+    dynamic-slice formulation would not. Normalised image data is always
+    finite, so this is a documented invariant rather than a runtime check.
     """
     n, h, w, c = x.shape
     nshift = 2 * pad + 1

@@ -14,7 +14,9 @@ broadcast instead of a scatter. Exactness: the selection itself is exact
 (``1.0 * logp[label] + 0.0 * rest``; adding f32 zeros preserves bits), so
 any deviation from the gather formulation comes only from softmax
 accumulation order — measured <= 5e-10 on f32 gradients, 1e-6 on the
-forward (pinned in ``tests/test_tpu_formulations.py``).
+forward (pinned in ``tests/test_tpu_formulations.py``). As with every
+zero-weight selection identity in this codebase (see
+``fedtpu.data.augment``), it requires FINITE logits: ``0.0 * inf = nan``.
 
 Parity: the loss itself matches the reference's ``nn.CrossEntropyLoss()``
 (`/root/reference/src/main.py:77`).

@@ -81,18 +81,33 @@ def test_acc_full_config_shape(monkeypatch):
     assert cfg.data.device_layout == "gather"  # committed-artifact semantics
 
 
-def test_unreachable_diagnostic_carries_live_pointer(bench, monkeypatch, capsys):
+def test_unreachable_diagnostic_carries_live_pointer(
+    bench, monkeypatch, capsys, tmp_path
+):
     """A wedged-tunnel bench moment must still record WHERE this round's
     live-captured number lives (value stays honestly 0.0 — the driver's
-    number must be the driver's run)."""
+    number must be the driver's run). Uses a synthetic artifact dir so the
+    test holds in any checkout (fresh export, pruned artifacts, code-only
+    CI), not just ones carrying committed bench data."""
+    import json
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    (art / "BENCH_LIVE_r99_stale.json").write_text(json.dumps(
+        {"value": 100.0, "unit": "client-epochs/sec/chip",
+         "captured_at": "2026-01-01T00:00:00"}))
+    (art / "BENCH_LIVE_r99.json").write_text(json.dumps(
+        {"value": 123.4, "unit": "client-epochs/sec/chip",
+         "captured_at": "2026-07-31T12:00:00", "device_kind": "TPU v5 lite"}))
+    (art / "BENCH_LIVE_r99_truncated.json").write_text('{"value": 999.9, ')
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
     monkeypatch.setattr(bench, "_backend_reachable", lambda: (False, "probe timed out"))
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     bench.main()
-    import json
 
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0.0
     assert "backend unreachable" in out["error"]
-    # This repo has committed live artifacts; the pointer must surface one.
-    assert out["live_artifact"].startswith("artifacts/BENCH_LIVE_")
-    assert out["live_value"] > 0
+    # Most recent VALID artifact wins; the truncated one must be skipped.
+    assert out["live_artifact"] == "artifacts/BENCH_LIVE_r99.json"
+    assert out["live_value"] == 123.4

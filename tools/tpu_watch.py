@@ -124,6 +124,10 @@ def _script_job(rel, timeout_s, artifact, env=None):
         ok = proc.returncode == 0 and os.path.exists(os.path.join(ART, artifact))
         tail = (proc.stderr or proc.stdout).strip()[-300:]
         return ok, f"rc={proc.returncode} {tail}" if not ok else f"wrote {artifact}"
+    # Expose the script path so run_pending can SKIP (not fail) jobs whose
+    # script hasn't landed yet — a missing script would otherwise trip
+    # stop-on-first-failure and starve the rest of the queue for the window.
+    run.script_path = os.path.join(REPO, rel)
     return run
 
 
@@ -163,9 +167,10 @@ def run_pending(state, lock_file):
         for name, job in JOBS:
             if name in state["done"]:
                 continue
-            path = os.path.join(REPO, "tools", "bench_profile_tpu.py")
-            if name == "mfu_profile" and not os.path.exists(path):
-                log(f"job {name}: script not present yet, skipping this window")
+            script = getattr(job, "script_path", None)
+            if script and not os.path.exists(script):
+                log(f"job {name}: script {os.path.relpath(script, REPO)} "
+                    "not present yet, skipping this window")
                 continue
             log(f"job {name}: starting")
             t0 = time.time()

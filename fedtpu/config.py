@@ -37,6 +37,14 @@ class OptimizerConfig:
     # per epoch; in federated mode one round == one local epoch).
     cosine_t_max: int = 200
     nesterov: bool = False
+    # HBM dtype of the per-client momentum buffers. "float32" is reference
+    # parity (torch SGD buffers are f32). "bfloat16" is an opt-in NON-PARITY
+    # mode that halves optimizer-state HBM traffic — BASELINE.md's bandwidth
+    # roofline names f32 param+momentum traffic (~0.5 GB/step at the
+    # 64-client bench) as a leading consumer. The buffer update is always
+    # computed in f32; only the stored buffer is rounded, so the mode's
+    # entire effect is one bf16 round-trip per step per buffer.
+    momentum_dtype: str = "float32"  # float32 | bfloat16
 
     def lr_at(self, round_idx) -> float:
         """Learning rate for a given round (traceable)."""

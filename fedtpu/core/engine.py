@@ -80,6 +80,11 @@ class Federation:
                 f"unknown device_layout {cfg.data.device_layout!r}; "
                 "have presharded | gather"
             )
+        if cfg.opt.momentum_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown momentum_dtype {cfg.opt.momentum_dtype!r}; "
+                "have float32 | bfloat16"
+            )
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(

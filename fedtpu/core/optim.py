@@ -19,7 +19,7 @@ the state is a bare pytree of buffers — trivially vmappable over clients.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +33,22 @@ class SGDState(NamedTuple):
     momentum: Pytree  # same structure as params
 
 
-def init(params: Pytree) -> SGDState:
-    return SGDState(momentum=jax.tree.map(jnp.zeros_like, params))
+def _momentum_dtype(cfg: Optional[OptimizerConfig]) -> jnp.dtype:
+    name = "float32" if cfg is None else cfg.momentum_dtype
+    if name not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown momentum_dtype {name!r}; have float32 | bfloat16"
+        )
+    return jnp.dtype(name)
+
+
+def init(params: Pytree, cfg: Optional[OptimizerConfig] = None) -> SGDState:
+    """Zero buffers in ``cfg.momentum_dtype`` (f32 when ``cfg`` is omitted —
+    the reference-parity default)."""
+    dtype = _momentum_dtype(cfg)
+    return SGDState(
+        momentum=jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), params)
+    )
 
 
 def apply(
@@ -44,10 +58,20 @@ def apply(
     lr,
     cfg: OptimizerConfig,
 ) -> Tuple[Pytree, SGDState]:
-    """One torch-semantics SGD step. ``lr`` may be a traced scalar."""
+    """One torch-semantics SGD step. ``lr`` may be a traced scalar.
 
+    With ``cfg.momentum_dtype='bfloat16'`` (non-parity, opt-in) the stored
+    buffers are bf16 but the update math stays f32: the buffer is upcast,
+    accumulated in f32, applied to the (f32) params, and only the STORED
+    buffer is rounded — so the mode is exactly one bf16 round-trip per
+    buffer per step, never a low-precision accumulation.
+    """
+    store_dtype = _momentum_dtype(cfg)
     decayed = jax.tree.map(lambda g, p: g + cfg.weight_decay * p, grads, params)
-    new_buf = jax.tree.map(lambda b, g: cfg.momentum * b + g, state.momentum, decayed)
+    new_buf = jax.tree.map(
+        lambda b, g: cfg.momentum * b.astype(jnp.float32) + g,
+        state.momentum, decayed,
+    )
     if cfg.nesterov:
         direction = jax.tree.map(
             lambda g, b: g + cfg.momentum * b, decayed, new_buf
@@ -55,4 +79,5 @@ def apply(
     else:
         direction = new_buf
     new_params = jax.tree.map(lambda p, d: p - lr * d, params, direction)
-    return new_params, SGDState(momentum=new_buf)
+    stored = jax.tree.map(lambda b: b.astype(store_dtype), new_buf)
+    return new_params, SGDState(momentum=stored)

@@ -126,7 +126,7 @@ def init_state(
         )
     n = cfg.fed.num_clients
     # Per-client momentum buffers, stacked along a new leading axis.
-    single = optim.init(params)
+    single = optim.init(params, cfg.opt)
     opt_state = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy(), single
     )

@@ -81,7 +81,7 @@ class SoloTrainer:
         variables = self.model.init(jax.random.PRNGKey(seed), sample, train=False)
         self.params = variables["params"]
         self.batch_stats = variables.get("batch_stats", {})
-        self.opt_state = optim.init(self.params)
+        self.opt_state = optim.init(self.params, cfg.opt)
         self.rng = jax.random.PRNGKey(seed + 1)
         self.epoch = 0
         self.best_acc = 0.0

@@ -2,27 +2,40 @@
 
 Two conv+pool stages and a two-layer dense head; no BatchNorm, so it is also
 the simplest all-weights FedAvg target.
+
+``smallcnn_avgpool`` is a NON-PARITY perf-ablation variant: identical
+parameters (pools are parameter-free), with both max-pools replaced by
+average pools. Max-pool's gradient lowers to ``select_and_scatter``, the
+largest single op family in the round-4 on-chip traces
+(``artifacts/MFU_PROFILE_r04_bf16.json``, ~34% of the fused dispatch) and
+the one both custom-VJP rewrites failed to beat (see
+``fedtpu.models.common._tiled_max_pool``); avg-pool's gradient is a dense
+broadcast with no scatter, so benching this variant bounds what
+``select_and_scatter`` actually costs END-TO-END rather than by
+trace-share arithmetic.
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
 
-from fedtpu.models.common import max_pool
+from fedtpu.models.common import avg_pool, max_pool
 from fedtpu.models.registry import register
 
 
 class SmallCNNModule(nn.Module):
     num_classes: int = 10
+    pool: str = "max"  # max | avg
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        pool = max_pool if self.pool == "max" else avg_pool
         x = nn.Conv(32, (3, 3), padding=1)(x)
         x = nn.relu(x)
-        x = max_pool(x, 2)
+        x = pool(x, 2)
         x = nn.Conv(64, (3, 3), padding=1)(x)
         x = nn.relu(x)
-        x = max_pool(x, 2)
+        x = pool(x, 2)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(128)(x))
         return nn.Dense(self.num_classes)(x)
@@ -31,3 +44,8 @@ class SmallCNNModule(nn.Module):
 @register("smallcnn")
 def SmallCNN(num_classes: int = 10) -> nn.Module:
     return SmallCNNModule(num_classes=num_classes)
+
+
+@register("smallcnn_avgpool")
+def SmallCNNAvgPool(num_classes: int = 10) -> nn.Module:
+    return SmallCNNModule(num_classes=num_classes, pool="avg")

@@ -108,7 +108,7 @@ class LocalTrainer:
         variables = self.model.init(jax.random.PRNGKey(seed), sample, train=False)
         self.params = variables["params"]
         self.batch_stats = variables.get("batch_stats", {})
-        self.opt_state = optim.init(self.params)
+        self.opt_state = optim.init(self.params, cfg.opt)
         self.rng = jax.random.PRNGKey(seed + 1)
         self.round_idx = 0
         self._local_update = jax.jit(make_local_update(self.model.apply, cfg))

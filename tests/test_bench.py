@@ -111,3 +111,38 @@ def test_unreachable_diagnostic_carries_live_pointer(
     # Most recent VALID artifact wins; the truncated one must be skipped.
     assert out["live_artifact"] == "artifacts/BENCH_LIVE_r99.json"
     assert out["live_value"] == 123.4
+
+
+def test_bench_model_wrapper_smoke(tmp_path, monkeypatch):
+    """tools/bench_model_tpu.py end-to-end at a seconds-scale CPU config —
+    the wrapper gates a TPU-window job, so a wrapper bug costs real chip
+    time. FEDTPU_BM_PLATFORM=cpu pins the platform IN-PROCESS (the env var
+    alone is ignored under the axon plugin)."""
+    import json as json_mod
+    import os
+    import subprocess
+    import sys as sys_mod
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               FEDTPU_BM_PLATFORM="cpu", FEDTPU_BM_MODEL="mlp",
+               FEDTPU_BM_DATASET="synthetic", FEDTPU_BM_CLIENTS="4",
+               FEDTPU_BM_BATCH="8", FEDTPU_BM_STEPS="2",
+               FEDTPU_BM_ROUNDS="2", FEDTPU_BM_OUT="SMOKE_BM_TEST.json")
+    try:
+        proc = subprocess.run(
+            [sys_mod.executable, os.path.join(repo, "tools", "bench_model_tpu.py")],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        line = json_mod.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "fedavg_rounds_per_sec_synthetic_mlp_4clients_1chip"
+        assert line["rounds_per_sec"] > 0
+        assert "error" not in line
+        art = os.path.join(repo, "artifacts", "SMOKE_BM_TEST.json")
+        assert os.path.exists(art)
+    finally:
+        try:
+            os.remove(os.path.join(repo, "artifacts", "SMOKE_BM_TEST.json"))
+        except OSError:
+            pass

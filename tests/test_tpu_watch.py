@@ -108,16 +108,47 @@ def test_state_roundtrip_tolerates_missing_and_corrupt(watch, tmp_path):
     assert watch.load_state()["done"] == ["x"]
 
 
-def test_run_pending_skips_mfu_profile_when_script_missing(watch, monkeypatch):
-    # The mfu_profile job has an existence guard (the script landed
-    # mid-round once): missing script -> skipped this window, NOT failed,
-    # NOT marked done, and later jobs still run.
+def test_run_pending_skips_any_job_whose_script_is_missing(watch, monkeypatch):
+    # Script-job existence guard (a script landed mid-round once): a job
+    # whose script_path doesn't exist yet is skipped this window — NOT
+    # failed (which would stop-on-first-failure the rest of the queue), NOT
+    # marked done — and later jobs still run. The guard is derived from the
+    # job's own script path, not its name (round-4 advisor finding: the
+    # name-matched guard covered exactly one job).
     calls = []
+
+    def missing():
+        calls.append("missing")
+        return True, ""
+
+    missing.script_path = str(watch.REPO) + "/tools/not_yet_written.py"
+
+    def present():
+        calls.append("present")
+        return True, ""
+
+    present.script_path = os.path.join(watch.REPO, "tools", "present.py")
+    open(present.script_path, "w").write("# exists")
     monkeypatch.setattr(watch, "JOBS", [
-        ("mfu_profile", lambda: (calls.append("mfu"), (True, ""))[1]),
-        ("b", lambda: (calls.append("b"), (True, ""))[1]),
+        ("missing", missing),
+        ("present", present),
+        ("plain", lambda: (calls.append("plain"), (True, ""))[1]),
     ])
     state = {"done": [], "history": []}
     assert watch.run_pending(state, _lock(watch)) is True
-    assert calls == ["b"]
-    assert state["done"] == ["b"]
+    assert calls == ["present", "plain"]
+    assert state["done"] == ["present", "plain"]
+
+
+def test_script_and_bench_jobs_expose_guards_and_env(watch):
+    # Every _script_job carries its script path for the skip guard; the
+    # real queue's script jobs must all point at existing tools. Bench jobs
+    # run bench.py (always present) so they carry no guard.
+    # JOBS paths were resolved against the REAL repo at module (re)load,
+    # before the fixture redirected watch.REPO into the sandbox.
+    for name, job in watch.JOBS:
+        path = getattr(job, "script_path", None)
+        if path is not None:
+            assert os.path.exists(path), (
+                f"queued job {name} points at a missing script: {path}"
+            )

@@ -33,6 +33,14 @@ mean aggregator only (a K-sized buffer is too small a population for robust
 statistics), no delta compression (sparse deltas against stale baselines
 corrupt aggregation), no DP (per-update participation accounting differs
 from the synchronous analysis).
+
+Mesh mode (``AsyncFederation(mesh=...)``, VERDICT r4 #6): ticks run under
+``shard_map`` over the clients axis. Async's per-client DIVERGED model
+copies shard exactly like presharded data rows — each device holds
+``3 * params * clients_per_device`` of trajectory state (local + pull
+snapshot + momentum; the sync engine holds 1x, momentum only) — and the
+buffer aggregation + scalar metrics become psums over ICI. Sharded ==
+single-program parity is pinned in ``tests/test_async_engine.py``.
 """
 
 from __future__ import annotations
@@ -46,7 +54,7 @@ import numpy as np
 from fedtpu.config import RoundConfig
 from fedtpu.core import optim
 from fedtpu.core.client import ClientOutput, make_local_update
-from fedtpu.core.round import _mean_over_clients, init_state
+from fedtpu.core.round import _mean_over_clients
 from fedtpu.utils import trees
 
 Pytree = Any
@@ -113,32 +121,68 @@ def _validate(cfg: RoundConfig) -> None:
 
 
 def init_async_state(
-    model, cfg: RoundConfig, rng: jax.Array, sample: jnp.ndarray
+    model, cfg: RoundConfig, rng: jax.Array, sample: jnp.ndarray, mesh=None
 ) -> AsyncState:
     """Start everyone synced at version 0 (the distributed edge's
-    ``sync_clients`` before the first update)."""
-    base = init_state(model, cfg, rng, sample, compressor=None)
-    n = cfg.fed.num_clients
+    ``sync_clients`` before the first update).
 
-    def rep(tree):
-        return jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy(), tree
+    With ``mesh`` EVERY ``[clients, ...]`` stack — the trajectory copies
+    (``client_*``/``base_*``), the momentum buffers, and the small
+    per-client vectors — is built inside one jit with sharded
+    ``out_shardings``, from nothing bigger than ONE global model copy: the
+    broadcasts partition across devices, so no device ever materialises a
+    full replicated per-client stack and populations whose
+    ``3 * params * clients`` exceeds one device's HBM (the very case the
+    mesh exists for) init without an OOM on device 0.
+
+    Value parity: the RNG splits mirror :func:`fedtpu.core.round.init_state`
+    exactly (init key -> model.init, client key -> per-client split), so
+    mesh and single-program inits are the same federation.
+    """
+    from fedtpu.core import server_opt
+
+    init_rng, client_rng = jax.random.split(rng)
+    variables = model.init(init_rng, sample, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    n = cfg.fed.num_clients
+    mom_dtype = optim._momentum_dtype(cfg.opt)
+
+    def build(params, batch_stats, client_key):
+        def rep(tree):
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (n,) + x.shape), tree
+            )
+
+        return AsyncState(
+            params=params,
+            batch_stats=batch_stats,
+            client_params=rep(params),
+            client_stats=rep(batch_stats),
+            base_params=rep(params),
+            base_stats=rep(batch_stats),
+            opt_state=optim.SGDState(momentum=jax.tree.map(
+                lambda p: jnp.zeros((n,) + p.shape, mom_dtype), params)),
+            client_rng=jax.random.split(client_key, n),
+            base_version=jnp.zeros((n,), jnp.int32),
+            version=jnp.zeros((), jnp.int32),
+            pending=jnp.zeros((n,), jnp.bool_),
+            server_opt_state=server_opt.init(cfg.fed, params),
+            last_client_loss=jnp.full((n,), jnp.nan, jnp.float32),
         )
 
-    return AsyncState(
-        params=base.params,
-        batch_stats=base.batch_stats,
-        client_params=rep(base.params),
-        client_stats=rep(base.batch_stats),
-        base_params=rep(base.params),
-        base_stats=rep(base.batch_stats),
-        opt_state=base.opt_state,
-        client_rng=base.client_rng,
-        base_version=jnp.zeros((n,), jnp.int32),
-        version=jnp.zeros((), jnp.int32),
-        pending=jnp.zeros((n,), jnp.bool_),
-        server_opt_state=base.server_opt_state,
-        last_client_loss=base.last_client_loss,
+    if mesh is None:
+        return jax.jit(build)(params, batch_stats, client_rng)
+    from jax.sharding import NamedSharding
+
+    from fedtpu.parallel.sharded import async_state_specs
+
+    specs = async_state_specs(cfg.mesh_axis)
+    out_shardings = type(specs)(
+        *(NamedSharding(mesh, getattr(specs, f)) for f in specs._fields)
+    )
+    return jax.jit(build, out_shardings=out_shardings)(
+        params, batch_stats, client_rng
     )
 
 
@@ -150,6 +194,7 @@ def make_async_step(
     shuffle: bool = True,
     image_shape: Optional[Tuple[int, ...]] = None,
     layout: str = "presharded",
+    axis_name: Optional[str] = None,
 ) -> Callable[..., Tuple[AsyncState, AsyncMetrics]]:
     """One tick: every live client trains ``steps`` batches on its OWN
     model; arriving clients' accumulated deltas aggregate into the global.
@@ -157,6 +202,14 @@ def make_async_step(
     ``step(state, images, labels, idx, mask, weights, arrive, alive,
     data_key)`` with ``arrive``/``alive``: [clients] bool,
     ``arrive & ~alive`` forbidden (host schedules arrivals among the live).
+
+    With ``axis_name`` this is the PER-SHARD body for
+    :func:`fedtpu.parallel.sharded.make_sharded_async_step`: the clients
+    axis of every per-client array is a mesh shard, the buffer aggregation
+    and the scalar metrics reduce with ``lax.psum`` over the axis (exactly
+    the sync round's collective pattern — per-client diverged model copies
+    shard like presharded data rows, so async costs no cross-device traffic
+    beyond the same delta all-reduce).
     """
     from fedtpu.core import server_opt as server_opt_lib
 
@@ -192,6 +245,16 @@ def make_async_step(
         rng = (
             jax.random.fold_in(data_key, state.version) if shuffle else None
         )
+        if rng is not None and axis_name is not None and layout == "gather":
+            # Decorrelate per-client shard permutations across mesh shards
+            # (mirrors make_data_round_step): the per-shard body sees only
+            # its local [clients/shards, L] rows, so without the axis fold
+            # every device would draw byte-identical permutation keys and
+            # clients c, c+n/shards, ... would shuffle in lockstep. The
+            # presharded rotation offset stays deliberately UNfolded — it is
+            # a shared scalar, which is what keeps mesh == single-program
+            # bit-parity there.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
         if layout == "presharded":
             # Contiguous rotated slice of the per-client rows (see
             # fedtpu.data.device: the gather below was measured to dominate
@@ -250,8 +313,8 @@ def make_async_step(
         stats_delta = jax.tree.map(
             lambda c, b: c - b, out.batch_stats, state.base_stats
         )
-        mean_delta = _mean_over_clients(deltas, agg_w, None)[0]
-        mean_stats_delta = _mean_over_clients(stats_delta, agg_w, None)[0]
+        mean_delta = _mean_over_clients(deltas, agg_w, axis_name)[0]
+        mean_stats_delta = _mean_over_clients(stats_delta, agg_w, axis_name)[0]
         new_params, new_server_opt = server_opt_lib.apply(
             server_opt, state.params, mean_delta, state.server_opt_state
         )
@@ -275,16 +338,24 @@ def make_async_step(
         new_base_stats = jax.tree.map(
             pull, state.base_stats, new_stats
         )
+        # Scalar metrics reduce over ALL clients; under shard_map each term
+        # is a per-shard partial that psums over the mesh axis.
+        def allsum(x):
+            s = jnp.sum(x)
+            return jax.lax.psum(s, axis_name) if axis_name is not None else s
+
         arrived_f = arrive.astype(jnp.float32)
-        n_arrived = jnp.sum(arrived_f)
+        n_arrived = allsum(arrived_f)
         trains_f = trains.astype(jnp.float32)
-        n_trained = jnp.maximum(jnp.sum(trains_f), 1.0)
+        n_trained = jnp.maximum(allsum(trains_f), 1.0)
         metrics = AsyncMetrics(
-            loss=jnp.sum(out.loss * trains_f) / n_trained,
-            accuracy=jnp.sum(out.accuracy * trains_f) / n_trained,
+            loss=allsum(out.loss * trains_f) / n_trained,
+            accuracy=allsum(out.accuracy * trains_f) / n_trained,
             num_arrived=n_arrived,
-            staleness_mean=jnp.sum(staleness * arrived_f)
+            staleness_mean=allsum(staleness * arrived_f)
             / jnp.maximum(n_arrived, 1.0),
+            # mean_delta is already the GLOBAL mean (psum'd above), so its
+            # norm is computed identically on every shard.
             update_norm=trees.tree_norm(mean_delta),
             per_client_loss=out.loss * trains_f,
         )
@@ -323,13 +394,15 @@ def make_multi_async_step(
     shuffle: bool = True,
     image_shape: Optional[Tuple[int, ...]] = None,
     layout: str = "presharded",
+    axis_name: Optional[str] = None,
 ):
     """``num_ticks`` ticks as ONE ``lax.scan`` program (the async analogue of
     :func:`fedtpu.data.device.make_multi_round_step`): ``arrive`` and
     ``alive`` become ``[num_ticks, clients]`` scan inputs, metrics come back
     stacked."""
     body = make_async_step(
-        model, cfg, steps, staleness_power, shuffle, image_shape, layout
+        model, cfg, steps, staleness_power, shuffle, image_shape, layout,
+        axis_name=axis_name,
     )
 
     def multi(state, images, labels, idx, mask, weights, arrive, alive,
@@ -367,7 +440,13 @@ class AsyncFederation:
         staleness_power: float = 0.5,
         speed_sigma: float = 0.0,
         data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        mesh=None,
     ):
+        """``mesh``: optional ``jax.sharding.Mesh`` over the clients axis —
+        ticks then run under ``shard_map`` with every per-client trajectory
+        (diverged params, pull snapshots, momentum) sharded across devices
+        and the buffer aggregation as a psum over ICI
+        (:func:`fedtpu.parallel.sharded.make_sharded_async_step`)."""
         from fedtpu.core.engine import Federation
 
         _validate(cfg)
@@ -378,24 +457,35 @@ class AsyncFederation:
         self.cfg = cfg
         self.buffer_k = buffer_k
         self.staleness_power = staleness_power
-        # Delegate builds model/data/partitions; its sync jits are lazy and
-        # never compiled unless used.
-        self._fed = Federation(cfg, seed=seed, data=data)
+        self.mesh = mesh
+        # Delegate builds model/data/partitions (mesh-placed when sharded);
+        # its sync jits are lazy and never compiled unless used.
+        self._fed = Federation(cfg, seed=seed, data=data, mesh=mesh)
         self.model = self._fed.model
         sample = jnp.zeros(
             (1,) + tuple(self._fed.images.shape[1:]), jnp.float32
         )
         self.state = init_async_state(
-            self.model, cfg, jax.random.PRNGKey(seed), sample
+            self.model, cfg, jax.random.PRNGKey(seed), sample, mesh=mesh
         )
-        self._step = jax.jit(
-            make_async_step(
-                self.model, cfg, self._fed._steps, staleness_power,
+        if mesh is None:
+            self._step = jax.jit(
+                make_async_step(
+                    self.model, cfg, self._fed._steps, staleness_power,
+                    shuffle=self._fed._shuffle,
+                    image_shape=self._fed._img_shape,
+                    layout=self._fed._layout,
+                ),
+                donate_argnums=(0,),
+            )
+        else:
+            from fedtpu.parallel.sharded import make_sharded_async_step
+
+            self._step = make_sharded_async_step(
+                self.model, cfg, mesh, self._fed._steps, staleness_power,
                 shuffle=self._fed._shuffle, image_shape=self._fed._img_shape,
                 layout=self._fed._layout,
-            ),
-            donate_argnums=(0,),
-        )
+            )
         # The delegate's synchronous FederatedState (per-client momentum
         # stack etc.) is never used here and would pin a second full
         # per-client pytree in HBM for the whole run — drop it.
@@ -452,15 +542,25 @@ class AsyncFederation:
             self.alive.copy(), (num_ticks, self.cfg.fed.num_clients)
         ).copy()
         if num_ticks not in self._multi_steps:
-            self._multi_steps[num_ticks] = jax.jit(
-                make_multi_async_step(
-                    self.model, self.cfg, self._fed._steps, num_ticks,
+            if self.mesh is None:
+                self._multi_steps[num_ticks] = jax.jit(
+                    make_multi_async_step(
+                        self.model, self.cfg, self._fed._steps, num_ticks,
+                        self.staleness_power, shuffle=self._fed._shuffle,
+                        image_shape=self._fed._img_shape,
+                        layout=self._fed._layout,
+                    ),
+                    donate_argnums=(0,),
+                )
+            else:
+                from fedtpu.parallel.sharded import make_sharded_async_step
+
+                self._multi_steps[num_ticks] = make_sharded_async_step(
+                    self.model, self.cfg, self.mesh, self._fed._steps,
                     self.staleness_power, shuffle=self._fed._shuffle,
                     image_shape=self._fed._img_shape,
-                    layout=self._fed._layout,
-                ),
-                donate_argnums=(0,),
-            )
+                    layout=self._fed._layout, num_ticks=num_ticks,
+                )
         d_images, d_labels, d_idx, d_mask = self._fed._ensure_device_data()
         self.state, m = self._multi_steps[num_ticks](
             self.state,

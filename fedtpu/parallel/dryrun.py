@@ -79,8 +79,45 @@ def dryrun_multichip(n_devices: int, model: str = "smallcnn") -> None:
     fed16 = Federation(bf16, seed=0, mesh=mesh)
     stacked16 = fed16.run_on_device(2)
     assert stacked16.loss.shape == (2,)
+
+    # Top-k delta compression (error feedback riding per-client comp_state,
+    # sharded by client) through the same mesh round (VERDICT r4 #8).
+    topk = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, compression="topk",
+                                     topk_fraction=0.1))
+    fedc = Federation(topk, seed=0, mesh=mesh)
+    mc = fedc.step()
+    assert np.isfinite(float(mc.loss))
+
+    # Byzantine-robust aggregation: the coordinate-wise median and the
+    # pairwise-distance Krum rule, both of which all_gather the per-shard
+    # deltas over the mesh axis (fedtpu.core.round._robust_over_clients).
+    robust_losses = {}
+    for rule in ("median", "krum"):
+        rcfg = dataclasses.replace(
+            cfg, fed=dataclasses.replace(cfg.fed, aggregator=rule,
+                                         weighted=False))
+        fedr = Federation(rcfg, seed=0, mesh=mesh)
+        mr = fedr.step()
+        robust_losses[rule] = float(mr.loss)
+        assert np.isfinite(robust_losses[rule])
+
+    # Async FedBuff tick under the mesh: per-client DIVERGED trajectories
+    # sharded by client, buffer aggregation as a psum (core.async_engine
+    # mesh mode).
+    from fedtpu.core import AsyncFederation
+
+    asyn = AsyncFederation(cfg, seed=0, buffer_k=2, mesh=mesh)
+    ma = asyn.tick()
+    assert int(asyn.state.version) == 1
+    assert np.isfinite(float(ma.loss))
+
     print(
         f"dryrun_multichip ok: {n_devices} devices, {n} clients, "
         f"loss={float(metrics.loss):.4f}, fused2_loss={float(stacked.loss[-1]):.4f}, "
-        f"bf16_fused2_loss={float(stacked16.loss[-1]):.4f}"
+        f"bf16_fused2_loss={float(stacked16.loss[-1]):.4f}, "
+        f"topk_loss={float(mc.loss):.4f}, "
+        f"median_loss={robust_losses['median']:.4f}, "
+        f"krum_loss={robust_losses['krum']:.4f}, "
+        f"async_tick_loss={float(ma.loss):.4f}"
     )

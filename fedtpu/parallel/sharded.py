@@ -81,6 +81,115 @@ def make_sharded_round_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def async_state_specs(axis: str):
+    """PartitionSpecs for :class:`fedtpu.core.async_engine.AsyncState`.
+
+    Same layout rule as the sync state: the global model (and the server
+    optimizer moments + version counter) replicated, every per-client array
+    sharded along the clients axis. Async's defining extra — per-client
+    DIVERGED model copies (``client_*``) and pull snapshots (``base_*``) —
+    shard by client exactly like presharded data rows, so per-device HBM is
+    ``3 * params * clients_per_device`` (local + base + momentum) instead of
+    ``3 * params * clients``: the mesh is what makes large async
+    populations fit, not a reason async can't shard.
+    """
+    from fedtpu.core.async_engine import AsyncState
+
+    return AsyncState(
+        params=P(),
+        batch_stats=P(),
+        client_params=P(axis),
+        client_stats=P(axis),
+        base_params=P(axis),
+        base_stats=P(axis),
+        opt_state=P(axis),
+        client_rng=P(axis),
+        base_version=P(axis),
+        version=P(),
+        pending=P(axis),
+        server_opt_state=P(),
+        last_client_loss=P(axis),
+    )
+
+
+def _async_data_specs(axis: str, layout: str):
+    """(images, labels, idx, mask) specs per device layout — mirrors
+    ``Federation._ensure_device_data``: presharded per-client rows shard by
+    client; the gather layout's flat dataset is replicated with only the
+    assignment sharded."""
+    if layout == "presharded":
+        return (P(axis), P(axis), P(axis), P(axis))
+    return (P(), P(), P(axis), P(axis))
+
+
+def make_sharded_async_step(
+    model: nn.Module,
+    cfg: RoundConfig,
+    mesh: Mesh,
+    steps: int,
+    staleness_power: float = 0.5,
+    shuffle: bool = True,
+    image_shape=None,
+    layout: str = "presharded",
+    num_ticks: int | None = None,
+):
+    """Jitted FedBuff tick (or ``num_ticks``-tick fused scan) over a client
+    mesh — the async analogue of :func:`make_sharded_round_step`. Buffer
+    aggregation and scalar metrics are ``psum`` collectives over ICI; the
+    host schedules arrivals exactly as in the single-program form.
+    """
+    from fedtpu.core.async_engine import (
+        AsyncMetrics,
+        make_async_step,
+        make_multi_async_step,
+    )
+
+    axis = cfg.mesh_axis
+    n_dev = mesh.devices.size
+    if cfg.fed.num_clients % n_dev:
+        raise ValueError(
+            f"num_clients={cfg.fed.num_clients} not divisible by mesh size {n_dev}"
+        )
+    if num_ticks is None:
+        body = make_async_step(
+            model, cfg, steps, staleness_power, shuffle=shuffle,
+            image_shape=image_shape, layout=layout, axis_name=axis,
+        )
+        sched_spec = P(axis)  # arrive/alive: [clients]
+    else:
+        body = make_multi_async_step(
+            model, cfg, steps, num_ticks, staleness_power, shuffle=shuffle,
+            image_shape=image_shape, layout=layout, axis_name=axis,
+        )
+        sched_spec = P(None, axis)  # arrive/alive: [ticks, clients]
+
+    metric_specs = AsyncMetrics(
+        loss=P(), accuracy=P(), num_arrived=P(), staleness_mean=P(),
+        update_norm=P(), per_client_loss=P(axis),
+    )
+    if num_ticks is not None:
+        # Stacked over the scan axis: scalars gain a leading ticks dim.
+        metric_specs = AsyncMetrics(
+            loss=P(), accuracy=P(), num_arrived=P(), staleness_mean=P(),
+            update_norm=P(), per_client_loss=P(None, axis),
+        )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            async_state_specs(axis),
+            *_async_data_specs(axis, layout),
+            P(axis),      # weights
+            sched_spec,   # arrive
+            sched_spec,   # alive
+            P(),          # data_key
+        ),
+        out_specs=(async_state_specs(axis), metric_specs),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def _put(x, mesh: Mesh, spec) -> jax.Array:
     """Place a host-global array onto the mesh.
 

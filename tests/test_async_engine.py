@@ -231,3 +231,85 @@ def test_one_epoch_per_pull_cycle():
     np.testing.assert_array_equal(after_first, c1_params())
     assert bool(a.state.pending[1])
     assert not bool(a.state.pending[0])  # arrived + re-pulled, trains anew
+
+
+def test_mesh_tick_matches_single_program():
+    """Async x mesh (VERDICT r4 weak #2 / next #6): the shard_map tick over
+    an 8-device client mesh must reproduce the single-program trajectory —
+    per-client diverged models shard like data rows, aggregation is a psum."""
+    import jax
+
+    from fedtpu.parallel import client_mesh
+
+    cfg = tiny_cfg(num_clients=8)
+    plain = AsyncFederation(cfg, seed=3, buffer_k=2, speed_sigma=0.8)
+    mesh = client_mesh(8, cfg.mesh_axis)
+    sharded = AsyncFederation(cfg, seed=3, buffer_k=2, speed_sigma=0.8,
+                              mesh=mesh)
+    for _ in range(3):
+        plain.tick()
+        sharded.tick()
+    assert int(sharded.state.version) == 3
+    np.testing.assert_allclose(
+        _flat(plain.state.params), _flat(sharded.state.params),
+        rtol=2e-6, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        _flat(plain.state.client_params), _flat(sharded.state.client_params),
+        rtol=2e-6, atol=1e-7,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.state.base_version),
+        np.asarray(sharded.state.base_version),
+    )
+    # And the fused multi-tick scan under the mesh agrees with ticking.
+    fused = AsyncFederation(cfg, seed=3, buffer_k=2, speed_sigma=0.8,
+                            mesh=mesh)
+    fused.run_on_device(3)
+    np.testing.assert_allclose(
+        _flat(sharded.state.params), _flat(fused.state.params),
+        rtol=2e-6, atol=1e-7,
+    )
+
+
+def test_mesh_async_metrics_match_single_program():
+    """Scalar metrics psum to the same totals the single program computes."""
+    from fedtpu.parallel import client_mesh
+
+    cfg = tiny_cfg(num_clients=8)
+    plain = AsyncFederation(cfg, seed=5, buffer_k=3, speed_sigma=0.5)
+    sharded = AsyncFederation(cfg, seed=5, buffer_k=3, speed_sigma=0.5,
+                              mesh=client_mesh(8, cfg.mesh_axis))
+    for _ in range(2):
+        mp = plain.tick()
+        ms = sharded.tick()
+    assert float(ms.num_arrived) == float(mp.num_arrived) == 3.0
+    np.testing.assert_allclose(float(ms.loss), float(mp.loss), rtol=2e-5)
+    np.testing.assert_allclose(
+        float(ms.staleness_mean), float(mp.staleness_mean), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ms.per_client_loss), np.asarray(mp.per_client_loss),
+        rtol=2e-5, atol=1e-7,
+    )
+
+
+def test_mesh_gather_layout_ticks_and_learns():
+    """Gather layout under the mesh: per-shard permutation keys are folded
+    with the axis index (review finding r5: without the fold, clients in
+    different shards shuffled in lockstep), so no bit-parity claim — just
+    soundness: ticks run, the model learns, nothing NaNs."""
+    import dataclasses
+
+    from fedtpu.parallel import client_mesh
+
+    cfg = tiny_cfg(num_clients=8)
+    cfg = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, device_layout="gather"))
+    asyn = AsyncFederation(cfg, seed=0, buffer_k=4, speed_sigma=0.0,
+                           mesh=client_mesh(8, cfg.mesh_axis))
+    for _ in range(8):
+        m = asyn.tick()
+        assert np.isfinite(float(m.loss))
+    test = load("synthetic", "test", num=256)
+    _, acc = asyn.evaluate(*test)
+    assert acc > 0.5, acc

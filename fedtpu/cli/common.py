@@ -72,6 +72,18 @@ def add_model_flags(p: argparse.ArgumentParser) -> None:
         "src/main.py:231-242); 'cosine' is the schedule it intended",
     )
     p.add_argument("--batch-size", default=128, type=int)
+    p.add_argument(
+        "--momentum-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="HBM dtype of the per-client momentum buffers. bfloat16 is a "
+        "flagged NON-PARITY mode that halves optimizer-state bandwidth "
+        "(update math stays f32; see OptimizerConfig.momentum_dtype)",
+    )
+    p.add_argument(
+        "--eval-batch-size", default=100, type=int,
+        help="test-set batch size (reference: src/main.py:56). Must not "
+        "exceed the eval set size — lower it for small/truncated datasets",
+    )
     p.add_argument("--seed", default=0, type=int)
     p.add_argument(
         "--num-examples",
@@ -175,10 +187,12 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
         opt=OptimizerConfig(
             learning_rate=args.lr,
             schedule=getattr(args, "schedule", "constant"),
+            momentum_dtype=getattr(args, "momentum_dtype", "float32"),
         ),
         data=DataConfig(
             dataset=args.dataset,
             batch_size=args.batch_size,
+            eval_batch_size=getattr(args, "eval_batch_size", 100),
             partition=getattr(args, "partition", "round_robin"),
             dirichlet_alpha=getattr(args, "dirichlet_alpha", 0.5),
             seed=args.seed,

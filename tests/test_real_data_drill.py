@@ -1,0 +1,74 @@
+"""Real-data readiness drill (VERDICT r4 #7).
+
+Every committed fedtpu accuracy number is synthetic because no real dataset
+exists in this environment (no egress). This drill proves the day real data
+lands, ZERO code changes are needed: a committed fixture in the GENUINE
+CIFAR-10 python-pickle byte layout (``tests/fixtures/cifar10_fixture``,
+written by ``tools/make_cifar_fixture.py`` — the exact format torchvision
+produces and the reference consumes, ``src/main.py:48-56``) drives the full
+CLI path through the REAL disk loader (``fedtpu/data/datasets.py
+load_cifar10``), and the run's own metrics must say so
+(``data_source: "disk"`` — the tag that stops synthetic runs masquerading).
+"""
+
+import json
+import os
+
+import pytest
+
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "cifar10_fixture")
+
+
+@pytest.fixture()
+def fixture_data(monkeypatch):
+    assert os.path.isdir(os.path.join(_FIXTURE, "cifar-10-batches-py"))
+    monkeypatch.setenv("FEDTPU_DATA_DIR", _FIXTURE)
+
+
+def test_loader_reads_fixture_from_disk(fixture_data):
+    import numpy as np
+
+    from fedtpu.data import data_source, load
+
+    x, y = load("cifar10", "train")
+    assert x.shape == (200, 32, 32, 3)  # 5 batches x 40, multi-file concat
+    assert data_source("cifar10", "train") == "disk"
+    xt, yt = load("cifar10", "test")
+    assert xt.shape == (64, 32, 32, 3)
+    assert data_source("cifar10", "test") == "disk"
+    # Normalised real bytes, not the synthetic surrogate: values live in the
+    # reference transform's range and every label class is in [0, 10).
+    assert float(np.abs(x).max()) < 3.0
+    assert set(np.unique(y)) <= set(range(10))
+
+
+def test_cli_end_to_end_on_disk_fixture(fixture_data, tmp_path):
+    """fedtpu-run trains + evals through the real CIFAR pickle path; its
+    metrics rows carry data_source='disk' and the model beats chance on the
+    class-structured fixture."""
+    from fedtpu.cli import run as cli_run
+
+    metrics = str(tmp_path / "m.jsonl")
+    rc = cli_run.main([
+        "--platform", "cpu",
+        "--model", "mlp", "--dataset", "cifar10",
+        "--num-clients", "2", "--rounds", "8", "--num-examples", "200",
+        "--batch-size", "10", "--steps-per-round", "10", "--lr", "0.05",
+        "--eval-batch-size", "32",  # the fixture's test split has 64 rows
+        "--partition", "iid", "--eval-every", "8",
+        "--metrics", metrics,
+    ])
+    assert rc == 0
+    with open(metrics) as fh:
+        rows = [json.loads(line) for line in fh]
+    assert rows, "no metrics written"
+    assert all(r["data_source"] == "disk" for r in rows)
+    assert rows[-1]["dataset"] == "cifar10"
+    evals = [r for r in rows if "test_acc" in r]
+    assert evals, "no eval row"
+    # The fixture is a learnable 10-class task (class prototypes + noise):
+    # 8 MLP rounds on 200 examples measured ~0.23 test acc — comfortably
+    # above the 0.1 chance floor (the drill proves the PLUMBING; accuracy
+    # at scale is the TPU parity harness's job).
+    assert evals[-1]["test_acc"] > 0.18, evals[-1]

@@ -88,6 +88,42 @@ def run_engine(args, n_dev):
     )
 
 
+def run_loss_sampling(args, n_dev):
+    """Loss-proportional participation sampling across two controllers: the
+    per-client loss vector is sharded by process, so each controller
+    allgathers the full vector and the round-seeded draw must yield the
+    SAME mask on every host — the property that makes the feature
+    multihost-safe (engine._alive_for_round)."""
+    from fedtpu.core import Federation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=4, partition="iid",
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=NUM_CLIENTS, participation_fraction=0.5,
+                      participation_sampling="loss"),
+        steps_per_round=2,
+    )
+    fed = Federation(cfg, seed=0, mesh=client_mesh(axis_name=cfg.mesh_axis))
+    masks = []
+    for r in range(4):
+        m = fed.step()
+        # Round 0 samples uniformly (no loss observed yet); later rounds
+        # weight by the allgathered loss vector.
+        assert int(m.num_active) == NUM_CLIENTS // 2
+        masks.append("".join(
+            "1" if v else "0" for v in fed._alive_for_round(r + 1)))
+    print(
+        f"multihost loss-sampling ok: process {args.process_id}, "
+        f"{n_dev} global devices, masks={masks}",
+        flush=True,
+    )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--process-id", type=int, required=True)
@@ -95,6 +131,10 @@ def main():
     p.add_argument("--engine", action="store_true",
                    help="drive Federation(mesh=...) instead of the raw "
                    "sharded round step")
+    p.add_argument("--loss-sampling", action="store_true",
+                   help="drive loss-proportional participation sampling "
+                   "across both controllers (allgathered loss vector, "
+                   "deterministic shared mask)")
     args = p.parse_args()
 
     multihost.initialize(
@@ -107,6 +147,8 @@ def main():
     assert n_dev == 4 * NUM_PROCESSES, n_dev
     if args.engine:
         return run_engine(args, n_dev)
+    if args.loss_sampling:
+        return run_loss_sampling(args, n_dev)
 
     cfg = RoundConfig(
         model="mlp",

@@ -92,23 +92,6 @@ class Federation:
                 f"'{cfg.data.dataset}' has {n_classes} classes — set "
                 f"RoundConfig(num_classes={n_classes})"
             )
-        # This check is LAST among validations: jax.process_count() is the
-        # first backend touch, and every cheap string/shape error above must
-        # surface before any backend init (which can hang on a wedged
-        # tunnel).
-        if (
-            cfg.fed.participation_sampling == "loss"
-            and jax.process_count() > 1
-        ):
-            # Each controller builds its own alive mask from its own loss
-            # observations; per-process PARTIAL observations would diverge
-            # the masks (and thus the program inputs) across controllers.
-            raise ValueError(
-                "participation_sampling='loss' is single-controller only: "
-                "per-client losses are sharded across processes and partial "
-                "observations would desynchronise the sampling masks. Use "
-                "'uniform' on multi-controller deployments."
-            )
         # Persistent XLA compile cache: on the wedge-prone remote-tunnel TPU
         # a large program's compile can outlive the tunnel window that
         # started it; caching at the engine layer covers every entrypoint
@@ -302,7 +285,27 @@ class Federation:
                 # Observations live in FederatedState (updated per round on
                 # device, NaN until first observed, checkpointed); fetched
                 # only here, when a sampling decision actually needs them.
-                obs = np.asarray(self._state.last_client_loss)[live]
+                # Multi-controller: the loss vector is SHARDED by client
+                # across processes, so every controller allgathers the full
+                # vector first — identical inputs + the round-seeded
+                # deterministic draw below then yield the SAME mask on every
+                # host (the desync hazard that previously made this
+                # single-controller only). Tested by a real two-process run
+                # (tests/test_multihost.py).
+                loss_vec = self._state.last_client_loss
+                if not getattr(loss_vec, "is_fully_addressable", True):
+                    # Mesh spanning processes: allgather yields the global
+                    # [N] vector on every host. Gate on addressability, NOT
+                    # process_count: a host-local vector under an initialized
+                    # cluster (mesh=None — independent federations per host)
+                    # is already complete, and tiled concatenation would
+                    # silently hand every host process 0's copy.
+                    from jax.experimental import multihost_utils
+
+                    loss_vec = multihost_utils.process_allgather(
+                        loss_vec, tiled=True
+                    )
+                obs = np.asarray(loss_vec)[live]
                 if not np.all(np.isnan(obs)):
                     # Never-observed clients get the optimistic fill (the
                     # max observed loss) so they are explored, not starved.

@@ -87,3 +87,13 @@ def test_two_process_federation_engine():
     # The agree check on "losses=" covers the whole suffix of the status
     # line, which includes the fused list — one assertion, both values.
     _run_and_check("multihost engine ok", "losses=", extra=["--engine"])
+
+
+def test_two_process_loss_sampling_masks_agree():
+    """Loss-proportional participation sampling over two controllers
+    (round-5: previously rejected as single-controller-only): each process
+    allgathers the sharded per-client loss vector, so the round-seeded draw
+    yields the SAME participation mask on both hosts — asserted via the
+    masks= suffix, which lists four consecutive rounds' masks."""
+    _run_and_check("multihost loss-sampling ok", "masks=",
+                   extra=["--loss-sampling"])

@@ -59,6 +59,13 @@ def main(argv=None) -> int:
     p.add_argument("--buffer-k", default=2, type=int)
     p.add_argument("--staleness-power", default=0.5, type=float)
     p.add_argument(
+        "--staleness-damping", default="on", choices=["on", "off"],
+        help="on (default): the staleness discount scales the applied "
+        "update's magnitude (FedBuff-paper semantics — fixes the "
+        "homogeneous-speed stall, see fedtpu.core.async_engine); off: "
+        "weight-normalized mean (round-4 artifact semantics)",
+    )
+    p.add_argument(
         "--speed-sigma",
         default=0.0,
         type=float,
@@ -85,17 +92,7 @@ def main(argv=None) -> int:
     )
     if args.async_updates:
         return _run_async(args, cfg)
-    mesh = None
-    if args.mesh == "auto":
-        import jax
-
-        n_dev = len(jax.devices())
-        if n_dev > 1 and args.num_clients % n_dev == 0:
-            from fedtpu.parallel import client_mesh
-
-            mesh = client_mesh()
-            logging.info("clients axis sharded over %d devices", n_dev)
-    fed = Federation(cfg, seed=args.seed, mesh=mesh)
+    fed = Federation(cfg, seed=args.seed, mesh=_auto_mesh(args))
 
     ckpt = None
     start_round = 0
@@ -187,6 +184,22 @@ def main(argv=None) -> int:
     return 0
 
 
+def _auto_mesh(args):
+    """--mesh auto: shard the clients axis when >1 device is visible and the
+    client count divides evenly. One rule for the sync AND async paths."""
+    if args.mesh != "auto":
+        return None
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev > 1 and args.num_clients % n_dev == 0:
+        from fedtpu.parallel import client_mesh
+
+        logging.info("clients axis sharded over %d devices", n_dev)
+        return client_mesh()
+    return None
+
+
 def _run_async(args, cfg) -> int:
     """Engine-side FedBuff loop (fedtpu.core.async_engine): --async-updates
     server updates, --fused-sized scan blocks, eval at block boundaries."""
@@ -196,14 +209,14 @@ def _run_async(args, cfg) -> int:
         logging.warning("--checkpoint-dir is ignored in async mode")
     if args.progress:
         logging.warning("--progress is ignored in async mode")
-    # --mesh is single-program here by design (the async engine is a
-    # single-chip study tool); --profile-dir IS honored below.
     fed = AsyncFederation(
         cfg,
         seed=args.seed,
         buffer_k=args.buffer_k,
         staleness_power=args.staleness_power,
         speed_sigma=args.speed_sigma,
+        mesh=_auto_mesh(args),
+        staleness_damping=args.staleness_damping == "on",
     )
     logger = MetricsLogger(path=args.metrics, echo=True)
     eval_data = load(

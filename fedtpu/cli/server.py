@@ -51,6 +51,13 @@ def main(argv=None) -> int:
         "discounted weights (the reference has no async mode)",
     )
     p.add_argument("--buffer-k", default=2, type=int)
+    p.add_argument("--staleness-power", default=0.5, type=float)
+    p.add_argument(
+        "--staleness-damping", default="on", choices=["on", "off"],
+        help="on (default): the staleness discount scales the applied "
+        "update's magnitude (FedBuff-paper semantics); off: "
+        "weight-normalized mean",
+    )
     p.add_argument(
         "--round-deadline",
         default=None,
@@ -124,6 +131,8 @@ def main(argv=None) -> int:
             primary.run_async(
                 num_updates=args.async_updates,
                 buffer_k=args.buffer_k,
+                staleness_power=args.staleness_power,
+                staleness_damping=args.staleness_damping == "on",
                 on_update=on_round,
             )
         else:

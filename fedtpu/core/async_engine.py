@@ -195,6 +195,7 @@ def make_async_step(
     image_shape: Optional[Tuple[int, ...]] = None,
     layout: str = "presharded",
     axis_name: Optional[str] = None,
+    staleness_damping: bool = True,
 ) -> Callable[..., Tuple[AsyncState, AsyncMetrics]]:
     """One tick: every live client trains ``steps`` batches on its OWN
     model; arriving clients' accumulated deltas aggregate into the global.
@@ -210,6 +211,20 @@ def make_async_step(
     the sync round's collective pattern — per-client diverged model copies
     shard like presharded data rows, so async costs no cross-device traffic
     beyond the same delta all-reduce).
+
+    ``staleness_damping`` (default True — the FedBuff-paper semantics,
+    Nguyen et al. 2022): the staleness discount scales the MAGNITUDE of the
+    applied update (``sum(disc_i * w_i * delta_i) / sum(w_i)``), not just
+    the relative mix. The alternative (False) is the round-4 semantics: a
+    weight-NORMALIZED mean (``/ sum(disc_i * w_i)``), where any uniform
+    discount cancels — measured consequence (round-5 sweep,
+    ``ASYNC_SYNC_CONVERGENCE.jsonl``): with homogeneous speeds (sigma=0,
+    k=2) buffer arrivals usually share one staleness value, the discount
+    cancels every tick, full-magnitude stale updates keep kicking the model
+    around, and smallcnn/cifar10_hard stalls at chance for 30+ ticks while
+    sigma=1 (mixed-staleness buffers, where relative weighting does bite)
+    converges. Damping restores the paper's magnitude-scaling and is the
+    fix for that stall.
     """
     from fedtpu.core import server_opt as server_opt_lib
 
@@ -302,11 +317,8 @@ def make_async_step(
             base_w = weights.astype(jnp.float32)
         else:
             base_w = jnp.ones((n,), jnp.float32)
-        agg_w = (
-            base_w
-            * arrive.astype(jnp.float32)
-            / (1.0 + staleness) ** staleness_power
-        )
+        raw_w = base_w * arrive.astype(jnp.float32)
+        agg_w = raw_w / (1.0 + staleness) ** staleness_power
         deltas = jax.tree.map(
             lambda c, b: c - b, out.params, state.base_params
         )
@@ -315,6 +327,20 @@ def make_async_step(
         )
         mean_delta = _mean_over_clients(deltas, agg_w, axis_name)[0]
         mean_stats_delta = _mean_over_clients(stats_delta, agg_w, axis_name)[0]
+
+        def allsum(x):
+            s = jnp.sum(x)
+            return jax.lax.psum(s, axis_name) if axis_name is not None else s
+
+        if staleness_damping:
+            # sum(disc*w*delta)/sum(w): rescale the normalized mean by
+            # sum(disc*w)/sum(w) so the discount damps the applied
+            # MAGNITUDE (see the docstring's stall mechanism).
+            damp = allsum(agg_w) / jnp.maximum(allsum(raw_w), 1e-9)
+            mean_delta = jax.tree.map(lambda d: d * damp, mean_delta)
+            mean_stats_delta = jax.tree.map(
+                lambda d: d * damp, mean_stats_delta
+            )
         new_params, new_server_opt = server_opt_lib.apply(
             server_opt, state.params, mean_delta, state.server_opt_state
         )
@@ -339,11 +365,7 @@ def make_async_step(
             pull, state.base_stats, new_stats
         )
         # Scalar metrics reduce over ALL clients; under shard_map each term
-        # is a per-shard partial that psums over the mesh axis.
-        def allsum(x):
-            s = jnp.sum(x)
-            return jax.lax.psum(s, axis_name) if axis_name is not None else s
-
+        # is a per-shard partial that psums over the mesh axis (allsum).
         arrived_f = arrive.astype(jnp.float32)
         n_arrived = allsum(arrived_f)
         trains_f = trains.astype(jnp.float32)
@@ -395,6 +417,7 @@ def make_multi_async_step(
     image_shape: Optional[Tuple[int, ...]] = None,
     layout: str = "presharded",
     axis_name: Optional[str] = None,
+    staleness_damping: bool = True,
 ):
     """``num_ticks`` ticks as ONE ``lax.scan`` program (the async analogue of
     :func:`fedtpu.data.device.make_multi_round_step`): ``arrive`` and
@@ -402,7 +425,7 @@ def make_multi_async_step(
     stacked."""
     body = make_async_step(
         model, cfg, steps, staleness_power, shuffle, image_shape, layout,
-        axis_name=axis_name,
+        axis_name=axis_name, staleness_damping=staleness_damping,
     )
 
     def multi(state, images, labels, idx, mask, weights, arrive, alive,
@@ -441,12 +464,16 @@ class AsyncFederation:
         speed_sigma: float = 0.0,
         data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         mesh=None,
+        staleness_damping: bool = True,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` over the clients axis —
         ticks then run under ``shard_map`` with every per-client trajectory
         (diverged params, pull snapshots, momentum) sharded across devices
         and the buffer aggregation as a psum over ICI
-        (:func:`fedtpu.parallel.sharded.make_sharded_async_step`)."""
+        (:func:`fedtpu.parallel.sharded.make_sharded_async_step`).
+        ``staleness_damping``: see :func:`make_async_step` — True (default)
+        is the FedBuff-paper magnitude-scaling semantics; False reproduces
+        the round-4 normalized-mean artifacts."""
         from fedtpu.core.engine import Federation
 
         _validate(cfg)
@@ -457,6 +484,7 @@ class AsyncFederation:
         self.cfg = cfg
         self.buffer_k = buffer_k
         self.staleness_power = staleness_power
+        self.staleness_damping = staleness_damping
         self.mesh = mesh
         # Delegate builds model/data/partitions (mesh-placed when sharded);
         # its sync jits are lazy and never compiled unless used.
@@ -475,6 +503,7 @@ class AsyncFederation:
                     shuffle=self._fed._shuffle,
                     image_shape=self._fed._img_shape,
                     layout=self._fed._layout,
+                    staleness_damping=staleness_damping,
                 ),
                 donate_argnums=(0,),
             )
@@ -485,6 +514,7 @@ class AsyncFederation:
                 self.model, cfg, mesh, self._fed._steps, staleness_power,
                 shuffle=self._fed._shuffle, image_shape=self._fed._img_shape,
                 layout=self._fed._layout,
+                staleness_damping=staleness_damping,
             )
         # The delegate's synchronous FederatedState (per-client momentum
         # stack etc.) is never used here and would pin a second full
@@ -549,6 +579,7 @@ class AsyncFederation:
                         self.staleness_power, shuffle=self._fed._shuffle,
                         image_shape=self._fed._img_shape,
                         layout=self._fed._layout,
+                        staleness_damping=self.staleness_damping,
                     ),
                     donate_argnums=(0,),
                 )
@@ -560,6 +591,7 @@ class AsyncFederation:
                     self.staleness_power, shuffle=self._fed._shuffle,
                     image_shape=self._fed._img_shape,
                     layout=self._fed._layout, num_ticks=num_ticks,
+                    staleness_damping=self.staleness_damping,
                 )
         d_images, d_labels, d_idx, d_mask = self._fed._ensure_device_data()
         self.state, m = self._multi_steps[num_ticks](

@@ -1,5 +1,7 @@
 from fedtpu.parallel.mesh import client_mesh, client_sharded, replicated
 from fedtpu.parallel.sharded import (
+    async_state_specs,
+    make_sharded_async_step,
     make_sharded_round_step,
     shard_batch,
     shard_state,
@@ -12,6 +14,8 @@ __all__ = [
     "client_mesh",
     "client_sharded",
     "replicated",
+    "async_state_specs",
+    "make_sharded_async_step",
     "make_sharded_round_step",
     "shard_batch",
     "shard_state",

@@ -132,6 +132,7 @@ def make_sharded_async_step(
     image_shape=None,
     layout: str = "presharded",
     num_ticks: int | None = None,
+    staleness_damping: bool = True,
 ):
     """Jitted FedBuff tick (or ``num_ticks``-tick fused scan) over a client
     mesh — the async analogue of :func:`make_sharded_round_step`. Buffer
@@ -154,12 +155,14 @@ def make_sharded_async_step(
         body = make_async_step(
             model, cfg, steps, staleness_power, shuffle=shuffle,
             image_shape=image_shape, layout=layout, axis_name=axis,
+            staleness_damping=staleness_damping,
         )
         sched_spec = P(axis)  # arrive/alive: [clients]
     else:
         body = make_multi_async_step(
             model, cfg, steps, num_ticks, staleness_power, shuffle=shuffle,
             image_shape=image_shape, layout=layout, axis_name=axis,
+            staleness_damping=staleness_damping,
         )
         sched_spec = P(None, axis)  # arrive/alive: [ticks, clients]
 

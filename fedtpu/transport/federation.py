@@ -902,6 +902,7 @@ class PrimaryServer:
         staleness_power: float = 0.5,
         stop: Optional[Callable[[], bool]] = None,
         on_update: Optional[Callable[[int, dict], None]] = None,
+        staleness_damping: bool = True,
     ) -> List[dict]:
         """Semi-asynchronous orchestration (FedBuff, Nguyen et al. 2022).
 
@@ -913,6 +914,13 @@ class PrimaryServer:
         is how many server updates landed since that client's base model.
         Fast clients contribute often; a slow client's (stale) delta still
         counts, just discounted — no one blocks anyone.
+
+        ``staleness_damping`` (default True): the discount scales the
+        applied update's MAGNITUDE (paper semantics, sum(disc*w*d)/sum(w));
+        False is the weight-normalized mean, where a uniform-staleness
+        buffer cancels the discount entirely — the mechanism behind the
+        engine-side homogeneous-speed stall measured in round 5
+        (:mod:`fedtpu.core.async_engine` docstring, the engine twin).
 
         The reference has no async mode at all (its barrier is
         ``src/server.py:132-135``); this composes with the plain mean
@@ -1050,18 +1058,23 @@ class PrimaryServer:
                 with version_lock:
                     v = self._async_version
                     stalenesses = [v - b for _, _, _, b in buf]
-                    weights = jnp.asarray(
-                        [
-                            (n if fed.weighted else 1.0)
-                            / (1.0 + s) ** staleness_power
-                            for (_, _, n, _), s in zip(buf, stalenesses)
-                        ],
-                        jnp.float32,
-                    )
+                    raw = [n if fed.weighted else 1.0 for _, _, n, _ in buf]
+                    disc = [
+                        w / (1.0 + s) ** staleness_power
+                        for w, s in zip(raw, stalenesses)
+                    ]
+                    weights = jnp.asarray(disc, jnp.float32)
                     stacked = jax.tree.map(
                         lambda *leaves: jnp.stack(leaves),
                         *[d for _, d, _, _ in buf],
                     )
+                    if staleness_damping:
+                        # sum(disc*w*d)/sum(w): rescale so the discount
+                        # damps the applied magnitude (see docstring).
+                        damp = sum(disc) / max(sum(raw), 1e-9)
+                        stacked = jax.tree.map(
+                            lambda l: l * jnp.asarray(damp, l.dtype), stacked
+                        )
                     new_global, self._server_opt_state = self._aggregate(
                         {"params": self.params,
                          "batch_stats": self.batch_stats},

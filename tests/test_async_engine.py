@@ -313,3 +313,41 @@ def test_mesh_gather_layout_ticks_and_learns():
     test = load("synthetic", "test", num=256)
     _, acc = asyn.evaluate(*test)
     assert acc > 0.5, acc
+
+
+def test_staleness_damping_scales_update_magnitude():
+    """Round-5 stall fix: with a uniform-staleness buffer the discount must
+    damp the APPLIED update by exactly (1+s)^-p (FedBuff-paper semantics);
+    the weight-normalized form (damping off) cancels it entirely. Setup:
+    client 0 arrives alone at tick 1 with staleness 1."""
+    import jax
+
+    def run(damping):
+        cfg = tiny_cfg(num_clients=2)
+        a = AsyncFederation(cfg, seed=0, buffer_k=1, speed_sigma=0.0,
+                            staleness_power=1.0, staleness_damping=damping)
+        schedule = [np.array([False, True]), np.array([True, False])]
+        a._arrive_mask = lambda: schedule.pop(0)
+        a.tick()                   # client 1 arrives fresh; 0 holds
+        m = a.tick()               # client 0 arrives with staleness 1
+        assert float(m.staleness_mean) == 1.0
+        return float(m.update_norm)
+
+    undamped = run(False)
+    damped = run(True)
+    # Same single-client buffer, same delta: damped norm = undamped / (1+1).
+    np.testing.assert_allclose(damped, undamped / 2.0, rtol=1e-5)
+
+
+def test_damping_is_identity_at_zero_staleness():
+    """buffer_k == N keeps every arrival at staleness 0, so damping must be
+    a no-op and the synchronous-parity anchor holds in BOTH modes."""
+    cfg = tiny_cfg(num_clients=4)
+    on = AsyncFederation(cfg, seed=0, buffer_k=4, staleness_damping=True)
+    off = AsyncFederation(cfg, seed=0, buffer_k=4, staleness_damping=False)
+    for _ in range(3):
+        on.tick()
+        off.tick()
+    np.testing.assert_allclose(
+        _flat(on.state.params), _flat(off.state.params), rtol=1e-6, atol=1e-7
+    )

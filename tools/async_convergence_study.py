@@ -65,7 +65,12 @@ def main():
         print(rows[-1], file=sys.stderr, flush=True)
 
     for sigma in (0.0, 1.0):
-        asyn = AsyncFederation(cfg, seed=0, buffer_k=2, speed_sigma=sigma)
+        # damping=False pinned: the fedbuff_k2_sigma* labels in the artifact
+        # mean the round-4 weight-normalized semantics; the damped (now
+        # engine-default) runs are fedbuff_stall_study.py --damped with
+        # *_damped labels.
+        asyn = AsyncFederation(cfg, seed=0, buffer_k=2, speed_sigma=sigma,
+                               staleness_damping=False)
         stale_total = 0.0
         for r in range(ROUNDS):
             m = asyn.tick()

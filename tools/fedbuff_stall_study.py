@@ -45,9 +45,12 @@ ART = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 TICKS = 25
 
 
-def run(mode, cfg, ticks=TICKS, staleness_power=0.5, out=None):
+def run(mode, cfg, ticks=TICKS, staleness_power=0.5, out=None,
+        speed_sigma=0.0, damping=False):
     asyn = AsyncFederation(cfg, seed=0, buffer_k=2,
-                           staleness_power=staleness_power, speed_sigma=0.0)
+                           staleness_power=staleness_power,
+                           speed_sigma=speed_sigma,
+                           staleness_damping=damping)
     test = load("cifar10_hard", "test", num=1024)
     accs = []
     for t in range(ticks):
@@ -77,8 +80,22 @@ def main():
     # keeps one point per lever at the theory-preferred setting and 15 ticks
     # per leg — enough to separate "recovers" from "still at chance" on a
     # task where the sync curve leaves chance by round ~8.
+    #
+    # Every leg here pins damping=False: this sweep DIAGNOSES the round-4
+    # (weight-normalized) semantics. The fix the diagnosis led to —
+    # staleness_damping, now the engine default — is measured by --damped.
     base = cfg_for()
     out_path = os.path.join(ART, "ASYNC_SYNC_CONVERGENCE.jsonl")
+    if "--damped" in sys.argv:
+        with open(out_path, "a") as out:
+            # The stalling config under the FIX (engine-default damping),
+            # and sigma=1 under damping to show the healthy regime doesn't
+            # regress.
+            run("fedbuff_k2_sigma0_damped", base, ticks=25, damping=True,
+                out=out)
+            run("fedbuff_k2_sigma1_damped", base, ticks=25, damping=True,
+                speed_sigma=1.0, out=out)
+        return
     with open(out_path, "a") as out:
         # The stalling config, longer — recovery or true stall?
         run("fedbuff_k2_sigma0_30ticks", base, ticks=30, out=out)

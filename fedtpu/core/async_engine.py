@@ -223,8 +223,22 @@ def make_async_step(
     cancels every tick, full-magnitude stale updates keep kicking the model
     around, and smallcnn/cifar10_hard stalls at chance for 30+ ticks while
     sigma=1 (mixed-staleness buffers, where relative weighting does bite)
-    converges. Damping restores the paper's magnitude-scaling and is the
-    fix for that stall.
+    converges. Damping restores the paper's magnitude-scaling.
+
+    Measured limits of damping (the full round-5 sweep, `*_damped` rows):
+    damping alone does NOT rescue the homogeneous-speed stall — neither
+    sp=0.5 (final 0.14) nor the strong sp=2 point (0.11) — because that
+    stall is ultimately SMALL-BUFFER VARIANCE: k-of-n aggregation applies
+    n/k times more updates per epoch-equivalent, each a k-sample mean, and
+    no staleness treatment (relative or magnitude) shrinks the variance of
+    FRESH arrivals. What recovers it is the step-size levers: client lr
+    0.05 -> 0.01 (0.50 vs 0.09 at tick 15) or server_lr ~ k/n (0.30,
+    climbing) — matching the FedBuff paper's tuned-server-lr practice.
+    Operational guidance: with homogeneous client speeds and k << n, scale
+    ``FedConfig(server_lr=...)`` toward k/n (or reduce client lr); damping
+    stays the right default because it bounds the staleness-amplification
+    error at negligible cost in the healthy heterogeneous regime (sigma=1:
+    0.59 damped vs 0.72 undamped at tick 25, both still climbing).
     """
     from fedtpu.core import server_opt as server_opt_lib
 

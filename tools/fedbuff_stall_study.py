@@ -88,11 +88,13 @@ def main():
     out_path = os.path.join(ART, "ASYNC_SYNC_CONVERGENCE.jsonl")
     if "--damped" in sys.argv:
         with open(out_path, "a") as out:
-            # The stalling config under the FIX (engine-default damping),
-            # and sigma=1 under damping to show the healthy regime doesn't
-            # regress.
+            # The stalling config under the engine-default damping, the
+            # strong-damping point (with damping, sp is a true magnitude
+            # knob), and sigma=1 under damping to check the healthy regime.
             run("fedbuff_k2_sigma0_damped", base, ticks=25, damping=True,
                 out=out)
+            run("fedbuff_k2_sigma0_damped_sp2", base, ticks=20,
+                staleness_power=2.0, damping=True, out=out)
             run("fedbuff_k2_sigma1_damped", base, ticks=25, damping=True,
                 speed_sigma=1.0, out=out)
         return

@@ -135,6 +135,9 @@ def main():
                    help="drive loss-proportional participation sampling "
                    "across both controllers (allgathered loss vector, "
                    "deterministic shared mask)")
+    p.add_argument("--all", action="store_true",
+                   help="run all three legs (raw round, engine, "
+                   "loss-sampling) in one process pair")
     args = p.parse_args()
 
     multihost.initialize(
@@ -145,11 +148,22 @@ def main():
     assert jax.process_count() == NUM_PROCESSES, jax.process_count()
     n_dev = len(jax.devices())
     assert n_dev == 4 * NUM_PROCESSES, n_dev
+    if args.all:
+        # Checked FIRST so --all always means all three legs, even combined
+        # with a single-leg flag. One process pair covers everything (each
+        # spawn costs ~20 s of jax import + gloo bring-up per process on
+        # this 1-core host).
+        run_raw(args, n_dev)
+        run_engine(args, n_dev)
+        return run_loss_sampling(args, n_dev)
     if args.engine:
         return run_engine(args, n_dev)
     if args.loss_sampling:
         return run_loss_sampling(args, n_dev)
+    return run_raw(args, n_dev)
 
+
+def run_raw(args, n_dev):
     cfg = RoundConfig(
         model="mlp",
         num_classes=10,

@@ -43,8 +43,17 @@ def _launch(port: int, extra=()):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
-            outs.append((p.returncode, out, err))
+            try:
+                # 480 s: the --all spawn runs jax import + gloo bring-up +
+                # THREE legs, and this 1-core host runs ~2x slower when a
+                # heavy job shares it. A timeout feeds the rc!=0 retry path
+                # instead of escaping as a raw TimeoutExpired.
+                out, err = p.communicate(timeout=480)
+                outs.append((p.returncode, out, err))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                outs.append((124, out or "", (err or "") + "\n[timeout 480s]"))
     finally:
         for p in procs:
             if p.poll() is None:
@@ -52,48 +61,48 @@ def _launch(port: int, extra=()):
     return outs
 
 
-def _run_and_check(marker: str, agree_key: str, extra=()):
-    """Launch both controllers, assert success + ``marker`` in each output,
-    and assert both agree on the ``agree_key``-tagged value (same psum
-    result). The free-port probe is inherently racy (the socket closes
-    before the coordinator binds it), so a failed attempt retries once on a
-    new port."""
+def _run_and_check(markers, agree_keys, extra=()):
+    """Launch both controllers, assert success + every ``markers`` entry
+    (a list) in each output, and assert both agree on every ``agree_keys``
+    (a list) tagged value (same psum result / same sampling masks). The
+    free-port probe is inherently racy (the socket closes before the
+    coordinator binds it), so a failed attempt retries once on a new
+    port."""
     for attempt in range(2):
         outs = _launch(_free_port(), extra=extra)
         if all(rc == 0 for rc, _, _ in outs) or attempt == 1:
             break
     for rc, out, err in outs:
         assert rc == 0, f"child failed (rc={rc}):\n{out}\n{err}"
-        assert marker in out, out
-    agreed = {line.split(agree_key)[1] for rc, out, _ in outs
-              for line in out.splitlines() if agree_key in line}
-    assert len(agreed) == 1, agreed
+        for marker in markers:
+            assert marker in out, out
+    for key in agree_keys:
+        agreed = {line.split(key)[1] for rc, out, _ in outs
+                  for line in out.splitlines() if key in line}
+        assert len(agreed) == 1, (key, agreed)
     return outs
 
 
-def test_two_process_distributed_round():
-    outs = _run_and_check("multihost ok", "loss=")
+def test_two_process_all_legs():
+    """ONE two-process jax.distributed spawn covering the three legs (each
+    spawn costs ~20 s of jax import + gloo bring-up per process on this
+    1-core host, so they share one cluster):
+
+    1. Raw sharded round: mesh spanning both processes, cross-process psum
+       FedAvg; both controllers agree on the aggregate loss.
+    2. The high-level Federation engine: sharded per-client state,
+       on-device gather, converging loss, then the fused multi-round scan
+       (run_on_device) — controllers agree on every round's aggregate and
+       the fused stack ("losses=" covers both lists).
+    3. Loss-proportional participation sampling (round-5: previously
+       rejected as single-controller-only): each process allgathers the
+       sharded per-client loss vector, so the round-seeded draw yields the
+       SAME mask on both hosts ("masks=" lists four consecutive rounds).
+    """
+    outs = _run_and_check(
+        ["multihost ok", "multihost engine ok", "multihost loss-sampling ok"],
+        ["loss=", "losses=", "masks="],
+        extra=["--all"],
+    )
     for _, out, _ in outs:
         assert "8 global devices" in out, out
-
-
-def test_two_process_federation_engine():
-    """The high-level Federation engine itself over two controllers: mesh
-    spanning both processes, sharded per-client state, on-device gather,
-    cross-process psum FedAvg, converging loss — and both controllers agree
-    on every round's aggregate. The run ends with the fused multi-round
-    scan (run_on_device) over the same multi-controller mesh; both
-    controllers must agree on its stacked losses too."""
-    # The agree check on "losses=" covers the whole suffix of the status
-    # line, which includes the fused list — one assertion, both values.
-    _run_and_check("multihost engine ok", "losses=", extra=["--engine"])
-
-
-def test_two_process_loss_sampling_masks_agree():
-    """Loss-proportional participation sampling over two controllers
-    (round-5: previously rejected as single-controller-only): each process
-    allgathers the sharded per-client loss vector, so the round-seeded draw
-    yields the SAME participation mask on both hosts — asserted via the
-    masks= suffix, which lists four consecutive rounds' masks."""
-    _run_and_check("multihost loss-sampling ok", "masks=",
-                   extra=["--loss-sampling"])

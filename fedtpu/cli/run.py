@@ -94,19 +94,14 @@ def main(argv=None) -> int:
         return _run_async(args, cfg)
     fed = Federation(cfg, seed=args.seed, mesh=_auto_mesh(args))
 
-    ckpt = None
-    start_round = 0
-    if args.checkpoint_dir:
-        ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
-        if args.resume:
-            latest = ckpt.restore_latest(like=fed.state)
-            if latest is not None:
-                start_round, state = latest
-                import jax
-                import jax.numpy as jnp
+    ckpt, start_round, state = _restore_from(args, like=fed.state)
+    if state is not None:
+        import jax
+        import jax.numpy as jnp
 
-                fed.state = jax.tree.map(jnp.asarray, state)
-                logging.info("resumed from round %d", start_round)
+        # Federation's state setter handles mesh re-placement.
+        fed.state = jax.tree.map(jnp.asarray, state)
+        logging.info("resumed from round %d", start_round)
 
     logger = MetricsLogger(path=args.metrics, echo=not args.progress)
     eval_data = load(
@@ -184,6 +179,22 @@ def main(argv=None) -> int:
     return 0
 
 
+def _restore_from(args, like):
+    """Shared --checkpoint-dir/-r machinery for the sync and async loops:
+    ``(checkpointer | None, start_index, restored_state | None)``. Callers
+    install the state themselves — the engines differ (Federation's state
+    setter vs AsyncFederation.load_state), both mesh-aware."""
+    if not args.checkpoint_dir:
+        return None, 0, None
+    ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
+    if not args.resume:
+        return ckpt, 0, None
+    latest = ckpt.restore_latest(like=like)
+    if latest is None:
+        return ckpt, 0, None
+    return ckpt, latest[0], latest[1]
+
+
 def _auto_mesh(args):
     """--mesh auto: shard the clients axis when >1 device is visible and the
     client count divides evenly. One rule for the sync AND async paths."""
@@ -205,8 +216,6 @@ def _run_async(args, cfg) -> int:
     server updates, --fused-sized scan blocks, eval at block boundaries."""
     from fedtpu.core import AsyncFederation
 
-    if args.checkpoint_dir:
-        logging.warning("--checkpoint-dir is ignored in async mode")
     if args.progress:
         logging.warning("--progress is ignored in async mode")
     fed = AsyncFederation(
@@ -218,6 +227,10 @@ def _run_async(args, cfg) -> int:
         mesh=_auto_mesh(args),
         staleness_damping=args.staleness_damping == "on",
     )
+    ckpt, start_tick, state = _restore_from(args, like=fed.state)
+    if state is not None:
+        fed.load_state(state)  # async re-placement (mesh-aware)
+        logging.info("resumed async state from update %d", start_tick)
     logger = MetricsLogger(path=args.metrics, echo=True)
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
@@ -226,17 +239,20 @@ def _run_async(args, cfg) -> int:
 
     t0 = time.time()
     with profile_rounds(args.profile_dir):
-        _async_loop(args, fed, logger, eval_data)
+        _async_loop(args, fed, logger, eval_data, ckpt, start_tick)
     dt = time.time() - t0
+    done = max(0, args.async_updates - start_tick)  # executed THIS run
     logging.info(
         "%d async updates in %.1fs (%.2f updates/s)",
-        args.async_updates, dt, args.async_updates / max(dt, 1e-9),
+        done, dt, done / max(dt, 1e-9),
     )
     return 0
 
 
-def _async_loop(args, fed, logger, eval_data) -> None:
-    t = 0
+def _async_loop(args, fed, logger, eval_data, ckpt=None, start_tick=0) -> None:
+    # Same resume semantics as the sync loop: --async-updates is the TOTAL
+    # update count, a resumed run finishes the remainder.
+    t = start_tick
     while t < args.async_updates:
         block = min(max(1, args.fused), args.async_updates - t)
         if block > 1:
@@ -264,6 +280,15 @@ def _async_loop(args, fed, logger, eval_data) -> None:
                 rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
             logger.log(t + i, **rec)
         t += block
+        if ckpt is not None:
+            crossed_ckpt = args.checkpoint_every and (
+                t // args.checkpoint_every
+                > (t - block) // args.checkpoint_every
+            )
+            if crossed_ckpt or t >= args.async_updates:
+                import jax
+
+                ckpt.save(t, jax.tree.map(np.asarray, fed.state))
 
 
 if __name__ == "__main__":

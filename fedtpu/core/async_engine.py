@@ -622,6 +622,34 @@ class AsyncFederation:
         self._tick_host += num_ticks
         return m
 
+    # ----------------------------------------------------- checkpoint/resume
+    def load_state(self, tree) -> None:
+        """Install a restored :class:`AsyncState` (host pytree from
+        :mod:`fedtpu.checkpoint`), re-placing it for the active topology —
+        mesh mode re-shards every per-client stack onto the clients axis.
+
+        Host-side scheduling state (the arrival RNG) intentionally does NOT
+        ride checkpoints: arrivals model EXTERNAL client timing, so a
+        resumed run draws a fresh schedule the same way a restarted real
+        deployment would. Everything learned (global + per-client
+        trajectories, momentum, versions, pending flags) is in the state.
+        """
+        host = AsyncState(*tree) if not isinstance(tree, AsyncState) else tree
+        if self.mesh is None:
+            self.state = jax.tree.map(jnp.asarray, host)
+            return
+        from fedtpu.parallel.sharded import _put, async_state_specs
+
+        specs = async_state_specs(self.cfg.mesh_axis)
+
+        def place(subtree, spec):
+            return jax.tree.map(lambda x: _put(x, self.mesh, spec), subtree)
+
+        self.state = AsyncState(
+            *(place(getattr(host, f), getattr(specs, f))
+              for f in AsyncState._fields)
+        )
+
     # ----------------------------------------------------------------- eval
     def evaluate(self, images: np.ndarray, labels: np.ndarray):
         """Evaluate the current GLOBAL model."""

@@ -164,6 +164,17 @@ JOBS = [
     ("mfu_profile_r05",
      _script_job("tools/bench_profile_tpu.py", 2400, "MFU_PROFILE_r05.json",
                  env={"FEDTPU_PROFILE_TAG": "r05"})),
+    # 8-9: cheap follow-ons if the window holds — deeper fusion (40 rounds
+    # per dispatch amortises the ~70 ms tunnel dispatch floor further) and
+    # the full experiment stack combined.
+    ("bench_fused40",
+     _bench_job("BENCH_LIVE_r05_fused40.json",
+                env={"FEDTPU_BENCH_TIMED_ROUNDS": "40"})),
+    ("bench_stack",
+     _bench_job("BENCH_LIVE_r05_stack.json",
+                env={"FEDTPU_MOMENTUM_DTYPE": "bfloat16",
+                     "FEDTPU_BENCH_MODEL": "smallcnn_avgpool",
+                     "FEDTPU_BENCH_TIMED_ROUNDS": "40"})),
 ]
 
 

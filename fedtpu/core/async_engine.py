@@ -100,6 +100,38 @@ class AsyncMetrics(NamedTuple):
     per_client_loss: jnp.ndarray
 
 
+def fedbuff_combine(
+    stacked: Pytree,
+    raw_w: jnp.ndarray,
+    staleness: jnp.ndarray,
+    staleness_power: float,
+    axis_name: Optional[str] = None,
+    staleness_damping: bool = True,
+):
+    """Combine a buffer of per-client contributions, FedBuff-style.
+
+    ``raw_w``: [clients] pre-discount weights, already zero for
+    non-arrivals. Damping (default): ``sum(disc*w*x) / sum(w)`` — the
+    staleness discount ``disc = (1+s)^-p`` scales the applied MAGNITUDE
+    (Nguyen et al. 2022). ``staleness_damping=False``: the weight-
+    normalized mean ``/ sum(disc*w)``, where any uniform discount cancels
+    (the round-4 semantics; see :func:`make_async_step` for the measured
+    consequences). Under ``shard_map`` the reductions psum over
+    ``axis_name``. Property-pinned in ``tests/test_properties.py``.
+    """
+    agg_w = raw_w / (1.0 + staleness) ** staleness_power
+    mean = _mean_over_clients(stacked, agg_w, axis_name)[0]
+    if not staleness_damping:
+        return mean
+
+    def allsum(x):
+        s = jnp.sum(x)
+        return jax.lax.psum(s, axis_name) if axis_name is not None else s
+
+    damp = allsum(agg_w) / jnp.maximum(allsum(raw_w), 1e-9)
+    return jax.tree.map(lambda d: d * damp, mean)
+
+
 def _validate(cfg: RoundConfig) -> None:
     if cfg.fed.compression != "none":
         raise ValueError(
@@ -332,29 +364,22 @@ def make_async_step(
         else:
             base_w = jnp.ones((n,), jnp.float32)
         raw_w = base_w * arrive.astype(jnp.float32)
-        agg_w = raw_w / (1.0 + staleness) ** staleness_power
         deltas = jax.tree.map(
             lambda c, b: c - b, out.params, state.base_params
         )
         stats_delta = jax.tree.map(
             lambda c, b: c - b, out.batch_stats, state.base_stats
         )
-        mean_delta = _mean_over_clients(deltas, agg_w, axis_name)[0]
-        mean_stats_delta = _mean_over_clients(stats_delta, agg_w, axis_name)[0]
+        combine = lambda tree: fedbuff_combine(  # noqa: E731
+            tree, raw_w, staleness, staleness_power,
+            axis_name=axis_name, staleness_damping=staleness_damping,
+        )
+        mean_delta = combine(deltas)
+        mean_stats_delta = combine(stats_delta)
 
         def allsum(x):
             s = jnp.sum(x)
             return jax.lax.psum(s, axis_name) if axis_name is not None else s
-
-        if staleness_damping:
-            # sum(disc*w*delta)/sum(w): rescale the normalized mean by
-            # sum(disc*w)/sum(w) so the discount damps the applied
-            # MAGNITUDE (see the docstring's stall mechanism).
-            damp = allsum(agg_w) / jnp.maximum(allsum(raw_w), 1e-9)
-            mean_delta = jax.tree.map(lambda d: d * damp, mean_delta)
-            mean_stats_delta = jax.tree.map(
-                lambda d: d * damp, mean_stats_delta
-            )
         new_params, new_server_opt = server_opt_lib.apply(
             server_opt, state.params, mean_delta, state.server_opt_state
         )

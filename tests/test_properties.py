@@ -150,3 +150,50 @@ def test_trimmed_mean_stays_within_live_range(n, cols, trim, seed):
     )
     lo, hi = x.min(axis=0), x.max(axis=0)
     assert (out >= lo - 1e-4).all() and (out <= hi + 1e-4).all()
+
+
+@_slow
+@given(
+    n=st.integers(2, 12),
+    k=st.integers(1, 4),
+    power=st.floats(0.1, 3.0),
+    seed=st.integers(0, 10),
+)
+def test_fedbuff_damped_update_never_exceeds_normalized(n, k, power, seed):
+    """Staleness damping (round 5), exercised through the ENGINE's own
+    combiner (fedtpu.core.async_engine.fedbuff_combine): the damped update
+    equals the normalized mean scaled by damp = sum(disc*w)/sum(w) with
+    0 < damp <= 1; with power > 0, damp == 1 exactly when every arrival
+    has staleness 0 — for ANY staleness pattern, weights, and buffer
+    size."""
+    from fedtpu.core.async_engine import fedbuff_combine
+
+    k = min(k, n)
+    rng = np.random.default_rng(seed)
+    arrive = np.zeros(n, bool)
+    arrive[rng.choice(n, size=k, replace=False)] = True
+    staleness = jnp.asarray(
+        rng.integers(0, 6, size=n).astype(np.float32))
+    weights = rng.uniform(0.5, 4.0, size=n).astype(np.float32)
+    raw_w = jnp.asarray(weights * arrive)
+    deltas = {"a": jnp.asarray(rng.normal(size=(n, 7)).astype(np.float32))}
+
+    damped = np.asarray(fedbuff_combine(
+        deltas, raw_w, staleness, power, staleness_damping=True)["a"])
+    normalized = np.asarray(fedbuff_combine(
+        deltas, raw_w, staleness, power, staleness_damping=False)["a"])
+
+    # Oracle: the paper's closed form sum(disc*w*d)/sum(w), in numpy.
+    disc_w = np.asarray(raw_w) / (1.0 + np.asarray(staleness)) ** power
+    oracle = (disc_w[:, None] * np.asarray(deltas["a"])).sum(0) / (
+        np.asarray(raw_w).sum())
+    np.testing.assert_allclose(damped, oracle, rtol=2e-5, atol=1e-6)
+
+    damp = disc_w.sum() / np.asarray(raw_w).sum()
+    assert 0.0 < damp <= 1.0 + 1e-6
+    assert np.linalg.norm(damped) <= np.linalg.norm(normalized) + 1e-5
+    stale_arrivals = np.asarray(staleness)[arrive]
+    if np.all(stale_arrivals == 0):
+        np.testing.assert_allclose(damped, normalized, rtol=1e-6)
+    else:
+        assert damp < 1.0  # power > 0 and a stale arrival MUST damp

@@ -210,3 +210,51 @@ def test_remat_unsupported_model_raises():
         models.create("lenet", num_classes=10, remat=True)
     # remat=False is accepted everywhere (a no-op).
     models.create("lenet", num_classes=10, remat=False)
+
+
+def test_sharded_gather_shuffle_decorrelates_across_shards(eight_devices):
+    """The gather layout's per-shard permutation keys fold the mesh axis
+    index (device.py): give all 8 clients IDENTICAL data and identical
+    assignment rows — then with one client per device, any per-client loss
+    difference can ONLY come from different batch ORDER, so distinct
+    losses pin the fold (without it every shard would draw byte-identical
+    permutations and all 8 losses would coincide). Control: unshuffled,
+    the same setup must produce identical losses."""
+    from fedtpu import models
+    from fedtpu.core import round as round_lib
+    from fedtpu.data.device import make_sharded_data_round_step
+    from fedtpu.parallel import client_mesh
+
+    n, steps, batch, dim = 8, 2, 4, 48
+    cfg = _cfg(
+        fed=FedConfig(num_clients=n),
+        data=DataConfig(dataset="synthetic", batch_size=batch,
+                        partition="iid", num_examples=64,
+                        device_layout="gather"),
+    )
+    mdl = models.create("mlp", num_classes=cfg.num_classes)
+    rng = np.random.default_rng(3)
+    images = jnp.asarray(rng.normal(size=(64, dim)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, size=64).astype(np.int32))
+    idx = jnp.broadcast_to(jnp.arange(64, dtype=jnp.int32), (n, 64))
+    mask = jnp.ones((n, 64), bool)
+    mesh = client_mesh(8, cfg.mesh_axis)
+    state = round_lib.init_state(
+        mdl, cfg, jax.random.PRNGKey(0), jnp.zeros((1, dim), jnp.float32)
+    )
+
+    losses = {}
+    for shuffle in (True, False):
+        step = make_sharded_data_round_step(
+            mdl, cfg, steps, mesh, shuffle=shuffle, donate=False,
+            image_shape=(dim,), layout="gather",
+        )
+        _, m = step(state, images, labels, idx, mask,
+                    jnp.ones((n,), jnp.float32), jnp.ones((n,), bool),
+                    jax.random.PRNGKey(5))
+        losses[shuffle] = np.asarray(m.per_client_loss)
+
+    # Unshuffled control: identical shards -> identical per-client losses.
+    assert len({round(float(v), 6) for v in losses[False]}) == 1, losses[False]
+    # Shuffled: the axis-index fold gives each shard its own permutation.
+    assert len({round(float(v), 6) for v in losses[True]}) > 1, losses[True]

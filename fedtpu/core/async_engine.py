@@ -18,12 +18,14 @@ genuinely hold diverged models), then holds that pending update until it
 tick. An arriving client contributes ``local_params - its_pull_snapshot``
 (exactly one local epoch computed against a possibly-stale base — the
 FedBuff client cycle: pull, train once, submit; NOT a compounding open-ended
-trajectory), weighted
-``(examples if weighted else 1) / (1 + staleness)**staleness_power`` where
-staleness counts server updates since its pull (FedBuff, Nguyen et al.
-2022 — the same rule as ``run_async``,
+trajectory), combined as ``sum(disc_i * w_i * delta_i) / sum(w_i)`` with
+``disc = (1 + staleness)**-staleness_power`` and ``w = examples`` (or 1
+unweighted), where staleness counts server updates since its pull — the
+discount scales the applied MAGNITUDE (FedBuff, Nguyen et al. 2022; see
+:func:`fedbuff_combine` for the round-4 normalized alternative and the
+measured reason damping is the default). Same rule as ``run_async``,
 :mod:`fedtpu.transport.federation`, whose gRPC clients likewise train one
-cycle per pull). After aggregation the arrivals re-pull the fresh global
+cycle per pull. After aggregation the arrivals re-pull the fresh global
 model and train anew next tick; clients awaiting arrival idle. No barrier
 anywhere: the reference's join-on-slowest (``src/server.py:132-135``)
 simply has no counterpart here.

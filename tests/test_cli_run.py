@@ -58,3 +58,31 @@ def test_fused_1_matches_per_round_cadence(tmp_path):
     assert rc == 0
     rows = _read_jsonl(metrics)
     assert [r["step"] for r in rows if "test_acc" in r] == [1, 3, 5]
+
+
+def test_async_checkpoint_resume_continues_to_total(tmp_path):
+    """Async-mode --checkpoint-dir/-r: the first run saves at cadence and
+    on completion; the resumed run continues from the restored update to
+    the TOTAL --async-updates (sync semantics) with step numbering carrying
+    on, and leaves a final-checkpoint file."""
+    metrics = str(tmp_path / "m.jsonl")
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        "--platform", "cpu",
+        "--model", "mlp", "--dataset", "synthetic",
+        "--num-clients", "3", "--num-examples", "192",
+        "--batch-size", "4", "--steps-per-round", "2", "--lr", "0.05",
+        "--partition", "iid", "--buffer-k", "2",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+        "--metrics", metrics,
+    ]
+    assert cli_run.main(base + ["--async-updates", "3"]) == 0
+    assert "round_3.fckpt" in os.listdir(ckpt)
+    rows = _read_jsonl(metrics)
+    assert [r["step"] for r in rows] == [0, 1, 2]
+
+    assert cli_run.main(base + ["--async-updates", "5", "-r"]) == 0
+    rows = _read_jsonl(metrics)
+    # Appended rows resume at update 3 and stop at the TOTAL of 5.
+    assert [r["step"] for r in rows] == [0, 1, 2, 3, 4]
+    assert "round_5.fckpt" in os.listdir(ckpt)

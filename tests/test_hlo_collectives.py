@@ -67,3 +67,33 @@ def test_median_path_gathers_the_client_axis(eight_devices):
     assert hlo.count("all-gather") > 0, (
         "robust aggregation needs the global client axis (all_gather)"
     )
+
+
+def test_async_mesh_tick_aggregates_via_all_reduce(eight_devices):
+    """The async tick's buffer combine (and its damping-factor sums) must
+    reach the interconnect as all-reduces, never by materialising the
+    client axis — the same drop-the-psum refactor hazard as the sync path,
+    now over fedbuff_combine."""
+    from fedtpu.core import AsyncFederation
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(),
+        data=DataConfig(dataset="synthetic", batch_size=4, num_examples=128),
+        fed=FedConfig(num_clients=8),
+        steps_per_round=2,
+    )
+    fed = AsyncFederation(cfg, seed=0, buffer_k=2,
+                          mesh=client_mesh(8, cfg.mesh_axis))
+    d = fed._fed._ensure_device_data()
+    arrive = jnp.zeros((8,), bool).at[:2].set(True)
+    alive = jnp.ones((8,), bool)
+    compiled = fed._step.lower(
+        fed.state, *d, fed._fed.weights, arrive, alive, fed._fed._data_key
+    ).compile()
+    hlo = compiled.as_text()
+    assert hlo.count("all-reduce") > 0, "async buffer psum vanished"
+    assert hlo.count("all-gather") == 0, (
+        "async mean aggregation should never materialise the client axis"
+    )

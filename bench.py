@@ -205,7 +205,23 @@ def _measure():
         "device_kind": device_kind,
         "backend": jax.default_backend(),
     }
+    result = _apply_variant_labels(result)
+    if flops_per_round:
+        result["flops_per_round"] = flops_per_round
+        peak = _peak_for(device_kind)
+        if peak:
+            result["mfu"] = round(rounds_per_sec * flops_per_round / (n_dev * peak), 4)
+    return result
+
+
+def _apply_variant_labels(result):
+    """Stamp variant runs so the artifact is self-distinguishing even to a
+    consumer keyed on 'metric' alone (ADVICE r5): suffix the metric string
+    AND drop vs_baseline — the 200/s target is defined for the parity
+    config only, so a ratio against it would be meaningless here."""
     if BENCH_MODEL != "smallcnn" or MOMENTUM_DTYPE != "float32" or _TIMED_ROUNDS_ENV:
+        result["metric"] = METRIC + "_variant"
+        result.pop("vs_baseline", None)
         result["variant"] = {
             "model": BENCH_MODEL, "momentum_dtype": MOMENTUM_DTYPE,
         }
@@ -214,12 +230,134 @@ def _measure():
             # so a fused-40 figure must self-label too (the gate is the ENV
             # knob, not the test-shrunk module constant).
             result["variant"]["timed_rounds"] = TIMED_ROUNDS
-    if flops_per_round:
-        result["flops_per_round"] = flops_per_round
-        peak = _peak_for(device_kind)
-        if peak:
-            result["mfu"] = round(rounds_per_sec * flops_per_round / (n_dev * peak), 4)
     return result
+
+
+def _compression_microbench():
+    """``compression_packed_vs_per_leaf``: flat vs per-leaf delta pipeline.
+
+    Compares the per-round codec + FedAvg-aggregation stage of the two
+    ``FedConfig.delta_layout`` modes on a many-leaf zoo model. "Dispatches"
+    = jaxpr primitive-equation count of that stage — the op count the
+    per-leaf path pays PER LEAF (one top_k / quantize / reduce each) and the
+    flat path pays once for the whole model; CPU-measurable, no accelerator
+    needed. The flat path's once-per-round pack/unpack (pure data movement
+    XLA folds into neighbouring fusions) is reported separately so the
+    ratio is auditable. Host wall time of the full jitted pipelines
+    (INCLUDING pack/unpack for flat) is recorded alongside.
+
+    Run via ``python bench.py --compression-microbench``; prints one JSON
+    line, separate from the headline metric.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedtpu import models as zoo
+    from fedtpu.core.round import _mean_over_clients
+    from fedtpu.ops import compression, flat as flat_ops
+
+    model_name = os.environ.get("FEDTPU_MB_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_MB_CLIENTS", "4"))
+    reps = int(os.environ.get("FEDTPU_MB_REPS", "3"))
+    fraction = 0.01
+
+    model = zoo.create(model_name, num_classes=10)
+    # eval_shape: leaf shapes without running the forward pass.
+    params = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+    )["params"]
+    lay = flat_ops.make_layout(params)
+    rng = np.random.default_rng(0)
+    deltas = jax.tree.map(
+        lambda s: jnp.asarray(
+            rng.normal(size=(clients,) + tuple(s.shape)).astype(np.float32)
+        ),
+        params,
+    )
+    weights = jnp.ones((clients,), jnp.float32)
+
+    def eqns(f, *args):
+        return len(jax.make_jaxpr(f)(*args).eqns)
+
+    def timed(fn, *args):
+        out = fn(*args)  # compile + warmup
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append((time.perf_counter() - t0) * 1e3)
+        return sorted(times)[len(times) // 2]
+
+    codecs = {}
+    for kind in ("topk", "int8"):
+        if kind == "topk":
+            per = compression.make_topk(fraction)
+            fl = compression.make_topk(fraction, layout="flat")
+        else:
+            per = compression.make_int8()
+            fl = compression.make_int8(layout="flat")
+        st_per = per.init(params, clients)
+        st_fl = fl.init(params, clients)
+
+        def per_leaf_stage(d, s):
+            out, new = per.apply(d, s)
+            mean, _ = _mean_over_clients(out, weights, None)
+            return mean, new
+
+        def flat_stage(y, s):
+            out, new = fl.apply_flat(y, s, lay)
+            mean, _ = _mean_over_clients(out, weights, None)
+            return mean, new
+
+        def flat_pipeline(d, s):
+            # End-to-end flat round stage including the once-per-round
+            # pack (clients x P) and unpack (one [P] row) — the honest
+            # wall-clock comparison.
+            mean, new = flat_stage(flat_ops.pack_stacked(lay, d), s)
+            return flat_ops.unpack(lay, mean), new
+
+        y0 = flat_ops.pack_stacked(lay, deltas)
+        n_per = eqns(per_leaf_stage, deltas, st_per)
+        n_fl = eqns(flat_stage, y0, st_fl)
+        codecs[kind] = {
+            "per_leaf_dispatches": n_per,
+            "flat_dispatches": n_fl,
+            "dispatch_ratio": round(n_fl / max(n_per, 1), 4),
+            "per_leaf_host_ms": round(
+                timed(jax.jit(per_leaf_stage), deltas, st_per), 3
+            ),
+            "flat_host_ms": round(
+                timed(jax.jit(flat_pipeline), deltas, st_fl), 3
+            ),
+        }
+
+    mean_row = jnp.zeros((lay.padded,), jnp.float32)
+    return {
+        "metric": "compression_packed_vs_per_leaf",
+        "unit": "jaxpr-eqns (codec + aggregation stage)",
+        "model": model_name,
+        "num_leaves": lay.num_leaves,
+        "num_params": lay.total,
+        "padded_row": lay.padded,
+        "num_clients": clients,
+        # Worst-case codec ratio — the acceptance headline (target <= 0.10).
+        "value": max(c["dispatch_ratio"] for c in codecs.values()),
+        "codecs": codecs,
+        # Once-per-round flat packing cost, reported for auditability: the
+        # pack touches [clients, P] once, the unpack ONE aggregated [P] row.
+        "flat_pack_dispatches": eqns(
+            lambda d: flat_ops.pack_stacked(lay, d), deltas
+        ),
+        "flat_unpack_dispatches": eqns(
+            lambda v: flat_ops.unpack(lay, v), mean_row
+        ),
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
 
 
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
@@ -323,6 +461,9 @@ def _print_diag(error: str) -> None:
 
 
 def main():
+    if "--compression-microbench" in sys.argv:
+        print(json.dumps(_compression_microbench()))
+        return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))
         return

@@ -12,7 +12,14 @@ from __future__ import annotations
 import argparse
 import logging
 
-from fedtpu.cli.common import add_model_flags, add_platform_flag, apply_platform_flag, build_config, compress_enabled
+from fedtpu.cli.common import (
+    add_compression_flags,
+    add_model_flags,
+    add_platform_flag,
+    apply_platform_flag,
+    build_config,
+    compress_enabled,
+)
 from fedtpu.transport.federation import serve_client
 
 
@@ -20,6 +27,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     add_platform_flag(p)
     add_model_flags(p)
+    add_compression_flags(p)
     p.add_argument("-a", "--address", default="localhost:50051",
                    help="bind address (doubles as the client's identity)")
     p.add_argument("--world", default=2, type=int,

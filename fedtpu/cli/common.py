@@ -100,6 +100,31 @@ def add_model_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_compression_flags(p: argparse.ArgumentParser) -> None:
+    """Delta-codec flags, shared by the simulated engine CLI, the gRPC
+    server AND the gRPC client (the client encodes its own wire payloads,
+    so it needs the codec + layout choice too)."""
+    p.add_argument(
+        "--compression",
+        default=None,
+        choices=["none", "topk", "int8"],
+        help="delta codec; default: topk when -c Y, none otherwise",
+    )
+    p.add_argument("--topk-fraction", default=0.01, type=float)
+    p.add_argument(
+        "--delta-layout",
+        default="per_leaf",
+        choices=["per_leaf", "flat"],
+        help="how client deltas travel through compression/aggregation and "
+        "the wire: per_leaf = one codec/reduce dispatch (and one wire "
+        "record) per pytree leaf (parity default); flat = pack all leaves "
+        "into one lane-aligned [clients, P] buffer per round "
+        "(fedtpu.ops.flat) — one top_k / quantize / reduce for the whole "
+        "model, ONE contiguous wire record, global top-k budget "
+        "(see docs/FLAT_DELTA.md)",
+    )
+
+
 def add_fed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--rounds", default=20, type=int,
                    help="federated rounds (reference hardcodes 20)")
@@ -111,13 +136,7 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         choices=["round_robin", "iid", "dirichlet"],
     )
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
-    p.add_argument(
-        "--compression",
-        default=None,
-        choices=["none", "topk", "int8"],
-        help="delta codec; default: topk when -c Y, none otherwise",
-    )
-    p.add_argument("--topk-fraction", default=0.01, type=float)
+    add_compression_flags(p)
     p.add_argument(
         "--aggregator",
         default="mean",
@@ -209,6 +228,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
             compression=compression,
             topk_fraction=getattr(args, "topk_fraction", 0.01),
+            delta_layout=getattr(args, "delta_layout", "per_leaf"),
             aggregator=getattr(args, "aggregator", "mean"),
             trim_fraction=getattr(args, "trim_fraction", 0.1),
             server_optimizer=getattr(args, "server_optimizer", "none"),

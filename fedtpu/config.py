@@ -120,6 +120,16 @@ class FedConfig:
     compression: str = "none"
     topk_fraction: float = 0.01
     error_feedback: bool = True
+    # HOW the per-client delta travels through compression/aggregation.
+    #   "per_leaf": every codec stage + the FedAvg reduction run once per
+    #     pytree leaf (the original path; the parity default).
+    #   "flat": all leaves are packed once per round into one lane-aligned
+    #     [clients, P] buffer (fedtpu.ops.flat) — one top_k / one quantize /
+    #     one reduction per round instead of hundreds on deep zoo models.
+    #     Bit-identical aggregates for compression='none' and 'int8'; for
+    #     'topk' the keep budget becomes GLOBAL across the model instead of
+    #     per-leaf (documented in docs/FLAT_DELTA.md).
+    delta_layout: str = "per_leaf"  # per_leaf | flat
     # Server-side optimizer applied to the aggregated delta (the FedOpt
     # family, Reddi et al. 2021 — "adaptive federated optimization"). The
     # reference applies the mean delta directly (src/server.py:170-179),

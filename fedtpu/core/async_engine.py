@@ -47,6 +47,7 @@ single-program parity is pinned in ``tests/test_async_engine.py``.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -58,6 +59,8 @@ from fedtpu.core import optim
 from fedtpu.core.client import ClientOutput, make_local_update
 from fedtpu.core.round import _mean_over_clients
 from fedtpu.utils import trees
+
+log = logging.getLogger(__name__)
 
 Pytree = Any
 
@@ -512,6 +515,18 @@ class AsyncFederation:
         (diverged params, pull snapshots, momentum) sharded across devices
         and the buffer aggregation as a psum over ICI
         (:func:`fedtpu.parallel.sharded.make_sharded_async_step`).
+
+        Mesh-vs-single-program parity caveat: with
+        ``DataConfig(device_layout='presharded')`` (the default) mesh and
+        single-program trajectories are BIT-IDENTICAL. With
+        ``device_layout='gather'`` they are NOT: the per-shard body folds
+        ``lax.axis_index`` into the shuffle key to decorrelate shard
+        permutations (see :func:`make_async_step`), so mesh runs draw
+        different per-client batch orders than single-program runs —
+        statistically equivalent training, but never compare the two
+        topologies' gather-layout trajectories update-for-update. A
+        one-line notice is logged when this combination is selected.
+
         ``staleness_damping``: see :func:`make_async_step` — True (default)
         is the FedBuff-paper magnitude-scaling semantics; False reproduces
         the round-4 normalized-mean artifacts."""
@@ -551,6 +566,13 @@ class AsyncFederation:
         else:
             from fedtpu.parallel.sharded import make_sharded_async_step
 
+            if self._fed._layout == "gather":
+                log.info(
+                    "async mesh + device_layout='gather': shard-decorrelated "
+                    "shuffle keys mean mesh trajectories are statistically "
+                    "equivalent but NOT bit-identical to single-program runs "
+                    "(presharded layout keeps bit parity)"
+                )
             self._step = make_sharded_async_step(
                 self.model, cfg, mesh, self._fed._steps, staleness_power,
                 shuffle=self._fed._shuffle, image_shape=self._fed._img_shape,

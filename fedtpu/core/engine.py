@@ -85,6 +85,11 @@ class Federation:
                 f"unknown momentum_dtype {cfg.opt.momentum_dtype!r}; "
                 "have float32 | bfloat16"
             )
+        if cfg.fed.delta_layout not in ("per_leaf", "flat"):
+            raise ValueError(
+                f"unknown delta_layout {cfg.fed.delta_layout!r}; "
+                "have per_leaf | flat"
+            )
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(

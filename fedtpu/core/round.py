@@ -371,6 +371,26 @@ def make_round_step(
     if stream is True:
         stream = "gather"
 
+    if cfg.fed.delta_layout not in ("per_leaf", "flat"):
+        raise ValueError(
+            f"unknown delta_layout {cfg.fed.delta_layout!r}; "
+            "have per_leaf | flat"
+        )
+    flat_mode = cfg.fed.delta_layout == "flat"
+    if compressor is not None:
+        comp_layout = getattr(compressor, "layout", "per_leaf")
+        if flat_mode and compressor.apply_flat is None:
+            raise ValueError(
+                "delta_layout='flat' needs a flat-layout compressor "
+                "(make_compressor reads FedConfig.delta_layout; or pass "
+                "make_topk/make_int8(..., layout='flat'))"
+            )
+        if not flat_mode and comp_layout == "flat":
+            raise ValueError(
+                "flat-layout compressor given but "
+                "FedConfig.delta_layout='per_leaf' — residual state shapes "
+                "would not match; make both agree"
+            )
     if cfg.fed.aggregator not in ("mean", "median", "trimmed_mean", "krum"):
         raise ValueError(
             f"unknown aggregator {cfg.fed.aggregator!r}; "
@@ -482,9 +502,26 @@ def make_round_step(
         deltas = jax.tree.map(
             lambda c, g: c - g[None], out.params, state.params
         )
+        if flat_mode:
+            # Pack ONCE per round into the lane-aligned [clients, P] buffer
+            # (fedtpu.ops.flat): compression, error feedback, DP clipping and
+            # the aggregation below each become one op over the whole model.
+            # A jnp array is itself a pytree, so every downstream combine
+            # (mean/median/trimmed_mean/krum, _dp_clip) applies unchanged;
+            # per-coordinate math is untouched, which is what keeps
+            # compression='none' and 'int8' bit-identical across layouts.
+            from fedtpu.ops import flat as flat_ops
+
+            flat_layout = flat_ops.make_layout(state.params)
+            deltas = flat_ops.pack_stacked(flat_layout, deltas)
         comp_state = state.comp_state
         if compressor is not None:
-            deltas, new_comp = compressor.apply(deltas, comp_state)
+            if flat_mode:
+                deltas, new_comp = compressor.apply_flat(
+                    deltas, comp_state, flat_layout
+                )
+            else:
+                deltas, new_comp = compressor.apply(deltas, comp_state)
             # Clients contributing nothing this round (agg_w == 0: dead,
             # non-sampled, or zero-weight) must not have their residuals
             # drained either — keep the old residual so the correction is
@@ -524,6 +561,11 @@ def make_round_step(
                 )
             mean_delta = combine(deltas)
             mean_stats_delta = combine(stats_delta)
+        if flat_mode:
+            # Unpack ONCE, on the aggregated [P] row (not per client) —
+            # BEFORE DP noise so the per-leaf noise draw is identical to the
+            # per-leaf layout's.
+            mean_delta = flat_ops.unpack(flat_layout, mean_delta)
         if cfg.fed.dp_clip_norm > 0 and cfg.fed.dp_noise_multiplier > 0:
             n_participants = jnp.sum((agg_w > 0).astype(jnp.float32))
             if axis_name is not None:

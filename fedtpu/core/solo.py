@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedtpu.utils.platform import shard_map
 from fedtpu import models as model_zoo
 from fedtpu.config import RoundConfig
 from fedtpu.core import optim
@@ -94,7 +95,7 @@ class SoloTrainer:
             axis = mesh.axis_names[0]
             body = self._make_train_step(axis_name=axis)
             self._train_step = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body,
                     mesh=mesh,
                     in_specs=(

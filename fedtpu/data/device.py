@@ -38,6 +38,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from fedtpu.utils.platform import shard_map
 from fedtpu.config import RoundConfig
 from fedtpu.core.round import (
     FederatedState,
@@ -386,7 +387,7 @@ def _shard_wrap(body, cfg: RoundConfig, mesh, alive_ndim: int, donate: bool,
             f"{mesh.devices.size}"
         )
     data_spec = P(axis) if layout == "presharded" else P()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(

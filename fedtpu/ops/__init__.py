@@ -12,13 +12,18 @@ from fedtpu.ops.compression import (
     make_topk,
     nnz_fraction,
 )
+from fedtpu.ops.flat import FlatLayout, make_layout, pack_stacked, unpack_stacked
 from fedtpu.ops.losses import softmax_ce_int_labels
 
 __all__ = [
     "Compressor",
+    "FlatLayout",
     "make_compressor",
     "make_int8",
+    "make_layout",
     "make_topk",
     "nnz_fraction",
+    "pack_stacked",
     "softmax_ce_int_labels",
+    "unpack_stacked",
 ]

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from fedtpu.config import FedConfig
+from fedtpu.ops import flat as flat_ops
 from fedtpu.ops import pallas_kernels as pk
 
 Pytree = Any
@@ -45,10 +46,24 @@ class Compressor(NamedTuple):
     stacked per-client deltas ``[clients, ...]`` to (compressed deltas, new
     state). ``apply`` is pure and jit/shard_map-safe; under ``shard_map`` the
     clients axis of both deltas and state is the sharded axis.
+
+    ``layout`` names the delta layout the codec was built for. Per-leaf
+    codecs (the default) map each pytree leaf independently. Flat codecs
+    (``layout="flat"``, :mod:`fedtpu.ops.flat`) additionally expose
+    ``apply_flat(flat_deltas, state, flat_layout)`` operating on the packed
+    ``[clients, P]`` buffer directly — the round step packs once and calls
+    it so the whole codec suite is a handful of fused ops instead of
+    per-leaf dispatches; residual state is then one ``[clients, P]`` buffer.
+    ``apply`` still works on pytrees for flat codecs (it packs/unpacks
+    internally), so standalone callers need not care about the layout.
     """
 
     init: Callable[[Pytree, int], Pytree]
     apply: Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]
+    layout: str = "per_leaf"
+    apply_flat: Optional[
+        Callable[[jnp.ndarray, Pytree, flat_ops.FlatLayout], Tuple[jnp.ndarray, Pytree]]
+    ] = None
 
 
 def _flatten_leaf(d: jnp.ndarray) -> jnp.ndarray:
@@ -102,7 +117,87 @@ def _make_apply(
     return apply
 
 
-def make_topk(fraction: float, error_feedback: bool = True) -> Compressor:
+def _make_flat_init(error_feedback: bool) -> Callable[[Pytree, int], Pytree]:
+    """Flat-layout residual initialiser: ONE ``[clients, P]`` buffer instead
+    of a per-leaf pytree (or ``()`` when error feedback is off)."""
+
+    def init(params: Pytree, num_clients: int) -> Pytree:
+        if not error_feedback:
+            return ()
+        lay = flat_ops.make_layout(params)
+        return jnp.zeros((num_clients, lay.padded), jnp.float32)
+
+    return init
+
+
+def _lift_flat(apply_flat) -> Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]:
+    """Pytree-level ``apply`` for a flat codec: pack once, run the flat
+    codec, unpack. Standalone-caller convenience — the round step packs its
+    own buffer and calls ``apply_flat`` directly."""
+
+    def apply(deltas: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
+        lay = flat_ops.make_layout_stacked(deltas)
+        out, new_state = apply_flat(
+            flat_ops.pack_stacked(lay, deltas), state, lay
+        )
+        return flat_ops.unpack_stacked(lay, out), new_state
+
+    return apply
+
+
+def _make_topk_flat(fraction: float, error_feedback: bool) -> Compressor:
+    """Flat-layout top-k: ONE ``top_k`` + ONE threshold kernel over the
+    whole ``[clients, P]`` buffer per round. The keep budget
+    ``k = ceil(fraction * total)`` is GLOBAL across the model — the same
+    overall budget as the per-leaf codec, spent on the globally largest
+    coordinates instead of quantised leaf-by-leaf (the documented semantic
+    difference between layouts; see docs/FLAT_DELTA.md)."""
+
+    def apply_flat(y, state, lay):
+        if error_feedback:
+            y = y + state
+        kth = flat_ops.topk_threshold(y, fraction, lay.total)
+        if kth is None:  # keep-all budget: nothing dropped, residual zero
+            return y, (jnp.zeros_like(y) if error_feedback else state)
+        if not error_feedback:
+            return jnp.where(jnp.abs(y) >= kth[:, None], y, 0.0), state
+        return pk.threshold_with_feedback(y, kth)
+
+    return Compressor(
+        init=_make_flat_init(error_feedback),
+        apply=_lift_flat(apply_flat),
+        layout="flat",
+        apply_flat=apply_flat,
+    )
+
+
+def _make_int8_flat(error_feedback: bool) -> Compressor:
+    """Flat-layout int8: one segment-max for every leaf's scale, one fused
+    elementwise quantize-dequantize over the whole buffer. Scales reproduce
+    the per-leaf codec exactly (max is order-independent), so this path is
+    bit-identical to ``layout='per_leaf'`` — pinned by the parity tests."""
+
+    def apply_flat(y, state, lay):
+        if error_feedback:
+            y = y + state
+        scale = flat_ops.int8_scales(y, lay)
+        safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+        out = jnp.clip(jnp.round(y / safe), -127.0, 127.0) * safe
+        if not error_feedback:
+            return out, state
+        return out, y - out
+
+    return Compressor(
+        init=_make_flat_init(error_feedback),
+        apply=_lift_flat(apply_flat),
+        layout="flat",
+        apply_flat=apply_flat,
+    )
+
+
+def make_topk(
+    fraction: float, error_feedback: bool = True, layout: str = "per_leaf"
+) -> Compressor:
     """Magnitude top-k sparsification with optional error feedback.
 
     Per leaf, per client: keep the ``ceil(fraction * size)`` largest-|.|
@@ -110,7 +205,15 @@ def make_topk(fraction: float, error_feedback: bool = True) -> Compressor:
     the next round's residual. Ties at the threshold may keep a few extra
     entries (threshold comparison is ``>=``) — harmless for convergence and
     it keeps the kernel a pure elementwise mask.
+
+    ``layout="flat"`` swaps in the packed single-buffer codec
+    (:func:`_make_topk_flat`): one ``top_k`` with a model-global threshold
+    instead of one per leaf.
     """
+    if layout == "flat":
+        return _make_topk_flat(fraction, error_feedback)
+    if layout != "per_leaf":
+        raise ValueError(f"unknown delta layout {layout!r}; have per_leaf | flat")
 
     def leaf(d: jnp.ndarray, e: Optional[jnp.ndarray]):
         shape = d.shape
@@ -134,14 +237,24 @@ def make_topk(fraction: float, error_feedback: bool = True) -> Compressor:
     return Compressor(init=_make_init(error_feedback), apply=_make_apply(leaf, error_feedback))
 
 
-def make_int8(error_feedback: bool = True) -> Compressor:
+def make_int8(
+    error_feedback: bool = True, layout: str = "per_leaf"
+) -> Compressor:
     """Symmetric per-leaf int8 quantization with optional error feedback.
 
     scale = max|delta + residual| / 127 per client per leaf; wire format is
     int8 codes + one f32 scale (4096x smaller metadata than the values).
     On-device we simulate quantize→dequantize so FedAvg averages the exact
     wire numbers.
+
+    ``layout="flat"`` swaps in the packed single-buffer codec
+    (:func:`_make_int8_flat`): same per-leaf scales (bit-identical), one
+    fused kernel instead of one per leaf.
     """
+    if layout == "flat":
+        return _make_int8_flat(error_feedback)
+    if layout != "per_leaf":
+        raise ValueError(f"unknown delta layout {layout!r}; have per_leaf | flat")
 
     def leaf(d: jnp.ndarray, e: Optional[jnp.ndarray]):
         shape = d.shape
@@ -157,13 +270,16 @@ def make_int8(error_feedback: bool = True) -> Compressor:
 
 
 def make_compressor(fed: FedConfig) -> Optional[Compressor]:
-    """Compressor from config (``FedConfig.compression``); None for 'none'."""
+    """Compressor from config (``FedConfig.compression`` +
+    ``FedConfig.delta_layout``); None for 'none'."""
     if fed.compression == "none":
         return None
     if fed.compression == "topk":
-        return make_topk(fed.topk_fraction, fed.error_feedback)
+        return make_topk(
+            fed.topk_fraction, fed.error_feedback, layout=fed.delta_layout
+        )
     if fed.compression == "int8":
-        return make_int8(fed.error_feedback)
+        return make_int8(fed.error_feedback, layout=fed.delta_layout)
     raise ValueError(f"unknown compression '{fed.compression}'")
 
 

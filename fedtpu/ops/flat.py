@@ -1,0 +1,202 @@
+"""Flat-buffer delta layout: every parameter leaf in ONE contiguous row.
+
+The per-leaf delta pipeline (:mod:`fedtpu.ops.compression`) dispatches each
+codec stage once per pytree leaf; on the zoo's deep architectures (DenseNet,
+DPN, RegNet — hundreds of leaves) that is hundreds of tiny ``top_k`` /
+elementwise / reduce ops per round. Communication-efficiency practice
+(Konečný et al., arXiv:1610.05492; FedJAX, arXiv:2108.02117) treats the
+client update as one flat vector instead. This module is the packer for that
+layout: all leaves flattened into one lane-aligned ``[clients, P]`` buffer
+with a static offsets table, so compression, error feedback, DP clipping and
+the FedAvg reduction each run as ONE op over the whole model.
+
+Offsets-table format (static, derived from the params template at trace
+time — never serialized with the data, both ends of a wire recompute it
+from the shared model definition):
+
+- leaves are enumerated in ``jax.tree_util.tree_flatten`` order;
+- ``offsets[i]`` is leaf ``i``'s start in the flat row, ``sizes[i]`` its
+  scalar count (``offsets[i+1] == offsets[i] + sizes[i]``);
+- ``total = sum(sizes)``; the row is padded with zeros to
+  ``padded = ceil(total / 128) * 128`` (TPU lane alignment, ``LANE``), so
+  the buffer tiles exactly under Mosaic's ``(8, 128)`` f32 rule and the
+  fused kernels in :mod:`fedtpu.ops.pallas_kernels` apply unchanged.
+
+Padding rule: the pad region is ALWAYS zero on entry to every op here, and
+every op here preserves that (thresholding keeps zeros at zero, quantization
+maps 0 -> 0, residuals of zeros are zero), so padding never leaks into
+codec statistics or aggregates and is simply dropped by :func:`unpack`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtpu.utils import trees
+
+Pytree = Any
+
+# TPU vector-lane width; rows padded to a multiple of this tile exactly.
+LANE = 128
+
+
+class FlatLayout(NamedTuple):
+    """Static description of how a params pytree maps into one flat row.
+
+    Hashable/static (shapes and offsets are plain ints), so it can be closed
+    over by jitted round steps; only the packed buffer itself is traced.
+    """
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]
+    sizes: Tuple[int, ...]
+    total: int  # real scalar count (sum of sizes)
+    padded: int  # lane-aligned row length P >= total
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.sizes)
+
+    @property
+    def pad(self) -> int:
+        return self.padded - self.total
+
+
+def _padded(total: int, lane: int) -> int:
+    return max(lane, int(math.ceil(max(total, 1) / lane)) * lane)
+
+
+def make_layout(template: Pytree, lane: int = LANE) -> FlatLayout:
+    """Layout from a (single, unstacked) params-shaped pytree. Works on
+    concrete arrays and on ``jax.eval_shape`` results alike — only shapes
+    and dtypes are read."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    shapes = tuple(tuple(int(d) for d in np.shape(l)) for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes)[:-1])
+    total = int(sum(sizes))
+    return FlatLayout(
+        treedef=treedef,
+        shapes=shapes,
+        dtypes=tuple(jnp.dtype(l.dtype) for l in leaves),
+        offsets=offsets,
+        sizes=sizes,
+        total=total,
+        padded=_padded(total, lane),
+    )
+
+
+def make_layout_stacked(stacked: Pytree, lane: int = LANE) -> FlatLayout:
+    """Layout from a ``[clients, ...]``-stacked delta pytree (the leading
+    axis is dropped from every leaf shape)."""
+    single = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(tuple(l.shape[1:]), l.dtype), stacked
+    )
+    return make_layout(single, lane)
+
+
+def segment_ids(layout: FlatLayout) -> np.ndarray:
+    """``[padded]`` int32 map coordinate -> leaf index; padding coordinates
+    get the extra segment ``num_leaves``. Host-side/static — used to compute
+    per-leaf statistics (e.g. int8 scales) on the flat buffer with ONE
+    segment reduction instead of one reduction per leaf."""
+    ids = np.full((layout.padded,), layout.num_leaves, np.int32)
+    for i, (off, size) in enumerate(zip(layout.offsets, layout.sizes)):
+        ids[off : off + size] = i
+    return ids
+
+
+# ------------------------------------------------------------------ packing
+def pack_stacked(layout: FlatLayout, stacked: Pytree) -> jnp.ndarray:
+    """``[clients, ...]`` pytree -> ``[clients, padded]`` f32 buffer.
+
+    One reshape per leaf plus one concatenate — pure data movement that XLA
+    folds into the surrounding program; all codec/aggregation math then runs
+    on the single result buffer.
+    """
+    leaves = jax.tree_util.tree_leaves(stacked)
+    if len(leaves) != layout.num_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, layout expects {layout.num_leaves}"
+        )
+    flat = trees.tree_concat_rows(stacked)
+    if layout.pad:
+        flat = jnp.pad(flat, ((0, 0), (0, layout.pad)))
+    return flat
+
+
+def unpack_stacked(layout: FlatLayout, flat: jnp.ndarray) -> Pytree:
+    """Inverse of :func:`pack_stacked`: ``[clients, padded]`` -> stacked
+    pytree (original leaf dtypes restored, padding dropped)."""
+    n = flat.shape[0]
+    leaves = [
+        flat[:, off : off + size].reshape((n,) + shape).astype(dt)
+        for off, size, shape, dt in zip(
+            layout.offsets, layout.sizes, layout.shapes, layout.dtypes
+        )
+    ]
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def pack(layout: FlatLayout, tree: Pytree) -> jnp.ndarray:
+    """Single (unstacked) pytree -> ``[padded]`` f32 row."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != layout.num_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, layout expects {layout.num_leaves}"
+        )
+    flat = trees.tree_concat_flat(tree)
+    if layout.pad:
+        flat = jnp.pad(flat, (0, layout.pad))
+    return flat
+
+
+def unpack(layout: FlatLayout, flat: jnp.ndarray) -> Pytree:
+    """``[padded]`` row -> pytree (original dtypes, padding dropped)."""
+    leaves = [
+        flat[off : off + size].reshape(shape).astype(dt)
+        for off, size, shape, dt in zip(
+            layout.offsets, layout.sizes, layout.shapes, layout.dtypes
+        )
+    ]
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+# ------------------------------------------------------------- flat codecs
+def topk_threshold(y: jnp.ndarray, fraction: float, total: int) -> Optional[jnp.ndarray]:
+    """Per-client GLOBAL keep threshold: k-th largest |y| across the whole
+    flat row, with ``k = ceil(fraction * total)`` counted against the REAL
+    (unpadded) coordinate count. Returns None when k covers everything
+    (keep-all). ONE ``top_k`` per round — the per-leaf path issues one per
+    leaf, and its per-leaf k quantises the budget leaf-by-leaf; the global
+    threshold spends the same overall budget on the globally largest
+    coordinates (the documented semantic difference between layouts)."""
+    k = max(1, int(math.ceil(fraction * total)))
+    if k >= total:
+        return None
+    return jax.lax.top_k(jnp.abs(y), k)[0][:, -1]
+
+
+def int8_scales(y: jnp.ndarray, layout: FlatLayout) -> jnp.ndarray:
+    """Per-coordinate int8 scale vector reproducing the per-leaf codec
+    EXACTLY: scale = max|leaf| / 127 per client per leaf, computed with one
+    segment-max over the flat row and gathered back to ``[clients, padded]``.
+    max is order-independent, so this is bit-identical to the per-leaf
+    reductions — the property the layout-parity tests pin."""
+    seg = jnp.asarray(segment_ids(layout))
+    maxes = jax.vmap(
+        lambda row: jax.ops.segment_max(
+            row,
+            seg,
+            num_segments=layout.num_leaves + 1,
+            indices_are_sorted=True,
+        )
+    )(jnp.abs(y))
+    return maxes[:, seg] / 127.0

@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedtpu.utils.platform import shard_map
 from fedtpu.config import RoundConfig
 from fedtpu.core.round import (
     FederatedState,
@@ -68,7 +69,7 @@ def make_sharded_round_step(
 
     body = make_round_step(model, cfg, compressor=compressor, axis_name=axis)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(state_specs(axis), batch_specs(axis)),
@@ -176,7 +177,7 @@ def make_sharded_async_step(
             loss=P(), accuracy=P(), num_arrived=P(), staleness_mean=P(),
             update_norm=P(), per_client_loss=P(None, axis),
         )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(

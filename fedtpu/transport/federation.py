@@ -191,12 +191,21 @@ class LocalTrainer:
             )
             extra = {"num_examples": np.float32(num_examples)}
             ef = cfg.fed.error_feedback
+            # delta_layout='flat' ships ONE contiguous record (index/value
+            # or int8 block + offsets table) instead of a per-leaf map —
+            # the wire twin of the engine's flat pipeline. The server's
+            # template-based sparse.decode dispatches on the record kind,
+            # so mixed fleets decode either form.
+            if cfg.fed.delta_layout == "flat":
+                enc_topk, enc_int8 = sparse.encode_topk_flat, sparse.encode_int8_flat
+            else:
+                enc_topk, enc_int8 = sparse.encode_topk, sparse.encode_int8
             encode = (
-                (lambda d, r: sparse.encode_topk(
+                (lambda d, r: enc_topk(
                     d, cfg.fed.topk_fraction, residuals=r, extra=extra,
                     collect_residual=ef))
                 if codec == "topk"
-                else (lambda d, r: sparse.encode_int8(
+                else (lambda d, r: enc_int8(
                     d, residuals=r, extra=extra, collect_residual=ef))
             )
             payload, residual = encode(delta, self.edge_residual if ef else None)
@@ -1071,9 +1080,18 @@ class PrimaryServer:
                     if staleness_damping:
                         # sum(disc*w*d)/sum(w): rescale so the discount
                         # damps the applied magnitude (see docstring).
-                        damp = sum(disc) / max(sum(raw), 1e-9)
+                        # Scale in f32 and cast the PRODUCT back: rounding
+                        # the factor itself to a narrow leaf dtype (bf16
+                        # wire payloads) would silently diverge from the
+                        # engine's f32 damping math.
+                        damp = jnp.asarray(
+                            sum(disc) / max(sum(raw), 1e-9), jnp.float32
+                        )
                         stacked = jax.tree.map(
-                            lambda l: l * jnp.asarray(damp, l.dtype), stacked
+                            lambda l: (
+                                l.astype(jnp.float32) * damp
+                            ).astype(l.dtype),
+                            stacked,
                         )
                     new_global, self._server_opt_state = self._aggregate(
                         {"params": self.params,

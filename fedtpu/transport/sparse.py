@@ -14,6 +14,17 @@ val) vs ``4n`` dense — a 50x reduction at f=0.01; int8 costs ``n`` bytes —
 Payloads are self-describing msgpack (no template needed to decode — nnz
 varies per round), with leaf order = ``jax.tree_util.tree_flatten`` order of
 the delta pytree, which both ends derive from the same model definition.
+
+Flat records (kinds ``topk_flat`` / ``int8_flat``, the wire form of the
+engine's ``FedConfig.delta_layout='flat'`` pipeline, :mod:`fedtpu.ops.flat`):
+instead of one msgpack map entry per leaf — hundreds of small records on
+deep zoo models — the whole delta travels as ONE contiguous index/value (or
+int8 code) block over the concatenated flat vector, plus a ``sizes`` offsets
+table for validation. Top-k selection is then GLOBAL across the model (one
+``kth_magnitude`` over the concatenation); int8 keeps per-leaf scales (a
+``[num_leaves]`` f32 array), matching the engine's flat codec bit-for-bit.
+The same ``FSP1`` frame carries all four kinds; :func:`decode` dispatches on
+``kind``, so receivers need no code change to accept flat senders.
 """
 
 from __future__ import annotations
@@ -35,7 +46,7 @@ from fedtpu.native import (
     quant_int8,
     unpack_sparse,
 )
-from fedtpu.transport.wire import WireError
+from fedtpu.transport.wire import WireError, frame as _wire_frame, unframe as _wire_unframe
 
 Pytree = Any
 
@@ -49,21 +60,11 @@ def is_sparse_payload(data: bytes) -> bool:
 
 
 def _frame(payload: bytes) -> bytes:
-    return _HEADER.pack(
-        _MAGIC, _VERSION, 0, zlib.crc32(payload) & 0xFFFFFFFF
-    ) + payload
+    return _wire_frame(_MAGIC, payload, 0, version=_VERSION)
 
 
 def _unframe(data: bytes) -> bytes:
-    if len(data) < _HEADER.size or data[:4] != _MAGIC:
-        raise WireError("not a fedtpu sparse payload")
-    _, version, _, crc = _HEADER.unpack_from(data)
-    if version != _VERSION:
-        raise WireError(f"unsupported sparse wire version {version}")
-    payload = data[_HEADER.size :]
-    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-        raise WireError("sparse payload CRC mismatch")
-    return payload
+    return _wire_unframe(_MAGIC, data, "sparse", version=_VERSION)[1]
 
 
 def encode_topk(
@@ -170,11 +171,184 @@ def encode_int8(
     return payload, residual_tree
 
 
+def _flat_concat(
+    leaves, res_leaves
+) -> Tuple[np.ndarray, list]:
+    """Concatenate leaves (+ residuals) into one f32 vector; returns
+    (vector, per-leaf sizes)."""
+    sizes = [int(np.size(l)) for l in leaves]
+    x = (
+        np.concatenate([np.asarray(l, np.float32).ravel() for l in leaves])
+        if leaves
+        else np.zeros((0,), np.float32)
+    )
+    if res_leaves is not None:
+        x = x + np.concatenate(
+            [np.asarray(r, np.float32).ravel() for r in res_leaves]
+        )
+    return x, sizes
+
+
+def _split_flat(vec: np.ndarray, leaves, treedef) -> Pytree:
+    """Inverse of the concat: slice ``vec`` back into leaf shapes."""
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.size(leaf))
+        out.append(vec[off : off + n].reshape(np.shape(leaf)))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def encode_topk_flat(
+    deltas: Pytree,
+    fraction: float,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = True,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Flat top-k wire record: ONE ``(indices, values)`` block over the
+    concatenated delta vector instead of one record per leaf.
+
+    The keep budget ``k = ceil(fraction * total)`` is GLOBAL across the
+    model (one :func:`fedtpu.native.kth_magnitude` over the concatenation) —
+    the wire twin of the engine's ``delta_layout='flat'`` top-k codec.
+    Error-feedback semantics match :func:`encode_topk`.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else None
+    )
+    x, sizes = _flat_concat(leaves, res_leaves)
+    k = max(1, int(math.ceil(fraction * max(x.size, 1))))
+    thresh = kth_magnitude(x, k)
+    if thresh == 0.0:
+        # Degenerate all-(near-)zero vector: keep only true nonzeros (the
+        # same rule as the per-leaf encoder's zero-leaf guard).
+        idx = np.flatnonzero(x).astype(np.int32)
+        vals = x[idx]
+        residual = np.zeros_like(x) if collect_residual else None
+    elif collect_residual:
+        idx, vals, residual = pack_sparse_with_residual(x, thresh)
+    else:
+        idx, vals = pack_sparse(x, thresh)
+        residual = None
+    body = {
+        "kind": "topk_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "idx": idx,
+        "vals": vals,
+        "extra": extra or {},
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    residual_tree = (
+        _split_flat(residual, leaves, treedef) if collect_residual else None
+    )
+    return payload, residual_tree
+
+
+def encode_int8_flat(
+    deltas: Pytree,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = False,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Flat int8 wire record: ONE contiguous code block + a ``[num_leaves]``
+    scale array instead of one record per leaf.
+
+    Scales stay PER LEAF (``max|leaf| / 127``) so the reconstruction is
+    bit-identical to :func:`encode_int8` — the same invariant the engine's
+    flat int8 codec pins against its per-leaf twin.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else None
+    )
+    x, sizes = _flat_concat(leaves, res_leaves)
+    codes = np.empty(x.size, np.int8)
+    scales = np.empty(len(sizes), np.float32)
+    residual = np.empty(x.size, np.float32) if collect_residual else None
+    off = 0
+    for i, n in enumerate(sizes):
+        seg = x[off : off + n]
+        c, s = quant_int8(seg)
+        codes[off : off + n] = c
+        scales[i] = s
+        if collect_residual:
+            residual[off : off + n] = seg - dequant_int8(c, s, n)
+        off += n
+    body = {
+        "kind": "int8_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "codes": codes,
+        "scales": scales,
+        "extra": extra or {},
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    residual_tree = (
+        _split_flat(residual, leaves, treedef) if collect_residual else None
+    )
+    return payload, residual_tree
+
+
+def _decode_flat(body: dict, leaves, treedef) -> Pytree:
+    """Reconstruct a dense delta pytree from a flat record body."""
+    sizes = np.asarray(body["sizes"], np.int64)
+    if len(sizes) != len(leaves):
+        raise WireError(
+            f"flat payload has {len(sizes)} leaves, template has {len(leaves)}"
+        )
+    for n, leaf in zip(sizes, leaves):
+        if int(n) != np.size(leaf):
+            raise WireError("flat leaf size mismatch with template")
+    total = int(sizes.sum())
+    if body["kind"] == "topk_flat":
+        idx = np.ascontiguousarray(body["idx"], np.int32)
+        # Untrusted wire data: the native scatter writes unchecked.
+        if idx.size and (idx.min() < 0 or idx.max() >= total):
+            raise WireError("sparse index out of range")
+        dense = unpack_sparse(idx, body["vals"], total)
+    else:  # int8_flat
+        codes = np.ascontiguousarray(body["codes"], np.int8)
+        if codes.size != total:
+            raise WireError("int8_flat code block size mismatch")
+        scales = np.asarray(body["scales"], np.float32)
+        if scales.size != len(sizes):
+            raise WireError("int8_flat scale table size mismatch")
+        dense = np.empty(total, np.float32)
+        off = 0
+        for n, s in zip(sizes, scales):
+            n = int(n)
+            dense[off : off + n] = dequant_int8(
+                codes[off : off + n], float(s), n
+            )
+            off += n
+    out = []
+    off = 0
+    for leaf in leaves:
+        n = int(np.size(leaf))
+        out.append(
+            dense[off : off + n]
+            .reshape(np.shape(leaf))
+            .astype(np.asarray(leaf).dtype)
+        )
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
     """Reconstruct a dense delta pytree shaped like ``like``; returns
     (deltas, extra)."""
     body = serialization.msgpack_restore(_unframe(data))
     leaves, treedef = jax.tree_util.tree_flatten(like)
+    if body.get("kind") in ("topk_flat", "int8_flat"):
+        return (
+            _decode_flat(body, leaves, treedef),
+            dict(body.get("extra", {})),
+        )
     if len(body["leaves"]) != len(leaves):
         raise WireError(
             f"sparse payload has {len(body['leaves'])} leaves, template has "

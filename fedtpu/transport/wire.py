@@ -45,6 +45,35 @@ class WireError(ValueError):
     """Malformed or corrupted payload."""
 
 
+def frame(
+    magic: bytes, payload: bytes, flags: int = 0, version: int = _VERSION
+) -> bytes:
+    """Frame ``payload`` under the shared fedtpu header layout
+    ``magic(4) | version(1) | flags(1) | crc32(4)`` — ONE implementation for
+    every wire format (dense ``FTP1`` here, sparse/flat ``FSP1`` in
+    :mod:`fedtpu.transport.sparse`), so the header structs cannot drift."""
+    return (
+        _HEADER.pack(magic, version, flags, zlib.crc32(payload) & 0xFFFFFFFF)
+        + payload
+    )
+
+
+def unframe(
+    magic: bytes, data: bytes, what: str = "wire", version: int = _VERSION
+):
+    """Validate + strip a :func:`frame` header; returns ``(flags, payload)``.
+    Raises :class:`WireError` on wrong magic, version, or CRC."""
+    if len(data) < _HEADER.size or data[:4] != magic:
+        raise WireError(f"not a fedtpu {what} payload")
+    _, ver, flags, crc = _HEADER.unpack_from(data)
+    if ver != version:
+        raise WireError(f"unsupported {what} version {ver}")
+    payload = data[_HEADER.size :]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError(f"{what} payload CRC mismatch")
+    return flags, payload
+
+
 def encode(
     tree: Pytree, compress: bool = False, level: int = 6, kind: str = "model"
 ) -> bytes:
@@ -64,8 +93,7 @@ def encode(
     if compress:
         payload = zlib.compress(payload, level)
         flags |= _FLAG_ZLIB
-    header = _HEADER.pack(_MAGIC, _VERSION, flags, zlib.crc32(payload) & 0xFFFFFFFF)
-    return header + payload
+    return frame(_MAGIC, payload, flags)
 
 
 def payload_kind(data: bytes) -> str:
@@ -81,14 +109,7 @@ def payload_kind(data: bytes) -> str:
 def decode(data: bytes, like: Pytree) -> Pytree:
     """Inverse of :func:`encode`. ``like`` supplies the pytree structure and
     leaf dtypes (flax msgpack restores *into* a template)."""
-    if len(data) < _HEADER.size or data[:4] != _MAGIC:
-        raise WireError("not a fedtpu wire payload")
-    _, version, flags, crc = _HEADER.unpack_from(data)
-    if version != _VERSION:
-        raise WireError(f"unsupported wire version {version}")
-    payload = data[_HEADER.size :]
-    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-        raise WireError("payload CRC mismatch")
+    flags, payload = unframe(_MAGIC, data)
     if flags & _FLAG_ZLIB:
         payload = zlib.decompress(payload)
     return serialization.from_bytes(like, payload)

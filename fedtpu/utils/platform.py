@@ -12,6 +12,31 @@ import re
 from typing import MutableMapping, Optional
 
 
+def shard_map(body, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions.
+
+    jax >= 0.6 exposes it as public ``jax.shard_map`` with the replication
+    checker spelled ``check_vma``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map`` with the same flag spelled
+    ``check_rep``. Every fedtpu call site goes through this one wrapper so a
+    version bump is a one-line change (and the 0.4.x environment actually
+    runs the mesh suite instead of AttributeError-ing on ``jax.shard_map``).
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
 def enable_compile_cache(path: Optional[str] = None) -> None:
     """Point jax's persistent compilation cache at ``path`` (default:
     ``FEDTPU_COMPILE_CACHE`` or ``~/.cache/fedtpu-xla``). On the remote-tunnel

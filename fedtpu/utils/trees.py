@@ -62,6 +62,28 @@ def tree_ravel(a: Pytree):
     return jax.flatten_util.ravel_pytree(a)
 
 
+def tree_concat_flat(a: Pytree) -> jnp.ndarray:
+    """Concatenate every leaf, raveled, into one ``[total]`` f32 vector
+    (``jax.tree_util.tree_flatten`` order). The single-tree packer primitive
+    behind the flat delta layout (:mod:`fedtpu.ops.flat`)."""
+    leaves = jax.tree_util.tree_leaves(a)
+    return jnp.concatenate(
+        [l.reshape((-1,)).astype(jnp.float32) for l in leaves]
+    )
+
+
+def tree_concat_rows(a: Pytree) -> jnp.ndarray:
+    """Concatenate ``[n, ...]``-stacked leaves into one ``[n, total]`` f32
+    buffer: each leaf reshaped to ``[n, size]``, joined along axis 1. Pure
+    data movement (XLA folds it into the surrounding program); the stacked
+    packer primitive behind the flat delta layout."""
+    leaves = jax.tree_util.tree_leaves(a)
+    n = leaves[0].shape[0]
+    return jnp.concatenate(
+        [l.reshape((n, -1)).astype(jnp.float32) for l in leaves], axis=1
+    )
+
+
 def tree_stack(trees):
     """Stack a list of identically-structured pytrees along a new axis 0."""
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)

@@ -46,6 +46,51 @@ def test_measure_contract(bench):
     assert result.get("flops_per_round", 0) > 0
 
 
+def test_variant_run_is_self_distinguishing(bench, monkeypatch):
+    """A variant bench artifact must be unmistakable even to a consumer
+    keyed on 'metric' alone (ADVICE r5): suffixed metric, no vs_baseline.
+    Exercises the labeling helper directly — re-running a full _measure for
+    this would cost ~1 min of tier-1 budget for no extra coverage."""
+    base = {"metric": bench.METRIC, "value": 1.0, "vs_baseline": 0.005}
+    # Parity config: labels untouched.
+    assert bench._apply_variant_labels(dict(base)) == base
+    monkeypatch.setattr(bench, "_TIMED_ROUNDS_ENV", "3")
+    result = bench._apply_variant_labels(dict(base))
+    assert result["metric"] == bench.METRIC + "_variant"
+    assert "vs_baseline" not in result
+    assert result["variant"]["timed_rounds"] == bench.TIMED_ROUNDS
+    monkeypatch.setattr(bench, "_TIMED_ROUNDS_ENV", "")
+    monkeypatch.setattr(bench, "MOMENTUM_DTYPE", "bfloat16")
+    result = bench._apply_variant_labels(dict(base))
+    assert result["metric"].endswith("_variant")
+    assert result["variant"]["momentum_dtype"] == "bfloat16"
+    assert "timed_rounds" not in result["variant"]
+
+
+def test_compression_microbench_contract(bench, monkeypatch):
+    """--compression-microbench JSON contract at a seconds-scale config:
+    dispatch counts present and the flat stage strictly cheaper than the
+    per-leaf stage (the <=10% acceptance gate itself is pinned on a
+    many-leaf model in tests/test_flat_layout.py)."""
+    monkeypatch.setenv("FEDTPU_MB_MODEL", "smallcnn")
+    monkeypatch.setenv("FEDTPU_MB_CLIENTS", "2")
+    monkeypatch.setenv("FEDTPU_MB_REPS", "1")
+    result = bench._compression_microbench()
+    assert result["metric"] == "compression_packed_vs_per_leaf"
+    assert result["num_leaves"] > 0
+    assert result["padded_row"] % 128 == 0
+    for kind in ("topk", "int8"):
+        c = result["codecs"][kind]
+        assert 0 < c["flat_dispatches"] < c["per_leaf_dispatches"]
+        assert c["dispatch_ratio"] == pytest.approx(
+            c["flat_dispatches"] / c["per_leaf_dispatches"], abs=1e-3
+        )
+        assert c["per_leaf_host_ms"] > 0 and c["flat_host_ms"] > 0
+    assert result["value"] == max(
+        c["dispatch_ratio"] for c in result["codecs"].values()
+    )
+
+
 def test_salvage_json_takes_last_valid_object(bench):
     text = 'garbage\n{"a": 1}\nnot json\n{"metric": "x", "value": 1}\ntrailing'
     assert bench._salvage_json(text) == '{"metric": "x", "value": 1}'

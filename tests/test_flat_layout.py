@@ -1,0 +1,314 @@
+"""Flat-buffer delta pipeline (fedtpu.ops.flat + FedConfig.delta_layout).
+
+Pins the tentpole invariants:
+
+- pack/unpack round-trips exactly (padding dropped, dtypes restored);
+- ``layout='flat'`` is BIT-IDENTICAL to ``per_leaf`` for
+  ``compression='none'`` and ``'int8'`` (codec level on two many-leaf zoo
+  architectures, round-step level on mlp), error feedback on and off;
+- ``topk`` flat implements the documented-equivalent GLOBAL budget: the
+  keep threshold spans the whole model instead of being quantised per leaf;
+- the flat wire record (one contiguous block + offsets table) round-trips;
+- the flat codec+aggregation stage issues <= 10% of the per-leaf stage's
+  op dispatches on a many-leaf model (the perf acceptance gate).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu import models
+from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.core import round as round_lib
+from fedtpu.ops import compression, flat as flat_ops
+
+MANY_LEAF_ARCHS = ["densenet_cifar", "mobilenetv2"]
+
+
+def arch_delta_tree(name, clients=2, seed=0):
+    """[clients, ...]-stacked random deltas shaped like a zoo model's params
+    — via eval_shape, so no forward pass is ever executed."""
+    model = models.create(name, num_classes=10)
+    params = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+    )["params"]
+    rng = np.random.default_rng(seed)
+    deltas = jax.tree.map(
+        lambda s: jnp.asarray(
+            rng.normal(size=(clients,) + tuple(s.shape)).astype(np.float32)
+        ),
+        params,
+    )
+    return params, deltas
+
+
+# ------------------------------------------------------------ pack / unpack
+def test_pack_unpack_roundtrip(rng):
+    tree = {
+        "w": jnp.asarray(rng.normal(size=(3, 7, 9)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32)),
+    }
+    lay = flat_ops.make_layout_stacked(tree)
+    assert lay.total == 7 * 9 + 5
+    assert lay.padded % flat_ops.LANE == 0 and lay.padded >= lay.total
+    # tree_flatten orders dict keys alphabetically: "b" (5) before "w" (63).
+    assert lay.sizes == (5, 63)
+    assert lay.offsets == (0, 5)
+    flat = flat_ops.pack_stacked(lay, tree)
+    assert flat.shape == (3, lay.padded)
+    # Padding region is zero.
+    np.testing.assert_array_equal(np.asarray(flat[:, lay.total :]), 0.0)
+    back = flat_ops.unpack_stacked(lay, flat)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(tree[k]))
+    # Single-row form.
+    single = {k: v[0] for k, v in tree.items()}
+    row = flat_ops.pack(lay, single)
+    back1 = flat_ops.unpack(lay, row)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(back1[k]), np.asarray(single[k]))
+
+
+def test_layout_is_static_and_lane_aligned():
+    params = {"a": np.zeros((130,), np.float32), "b": np.zeros((2, 2), np.float32)}
+    lay = flat_ops.make_layout(params)
+    assert lay.sizes == (130, 4)
+    assert lay.total == 134
+    assert lay.padded == 256  # next multiple of 128
+    ids = flat_ops.segment_ids(lay)
+    assert ids.shape == (256,)
+    assert (ids[:130] == 0).all() and (ids[130:134] == 1).all()
+    assert (ids[134:] == 2).all()  # padding segment
+
+
+def test_pack_rejects_wrong_tree():
+    lay = flat_ops.make_layout({"a": np.zeros((4,), np.float32)})
+    with pytest.raises(ValueError):
+        flat_ops.pack_stacked(lay, {"a": jnp.zeros((2, 4)), "b": jnp.zeros((2, 1))})
+
+
+# ------------------------------------- codec parity on many-leaf zoo models
+@pytest.mark.parametrize("arch", MANY_LEAF_ARCHS)
+@pytest.mark.parametrize("error_feedback", [True, False])
+def test_int8_flat_bit_identical_on_arch(arch, error_feedback):
+    params, deltas = arch_delta_tree(arch)
+    per = compression.make_int8(error_feedback=error_feedback)
+    fl = compression.make_int8(error_feedback=error_feedback, layout="flat")
+    s_per = per.init(params, 2)
+    s_fl = fl.init(params, 2)
+    # Deliberately NOT jitted: tracing+compiling a 360-leaf program twice
+    # per param set would dominate tier-1 runtime; op-by-op execution is
+    # numerically identical (each op is still compiled individually).
+    o_per, n_per = per.apply(deltas, s_per)
+    o_fl, n_fl = fl.apply(deltas, s_fl)
+    for a, b in zip(jax.tree.leaves(o_per), jax.tree.leaves(o_fl)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if error_feedback:
+        # Residuals identical too (flat state compared leaf-wise via unpack).
+        lay = flat_ops.make_layout(params)
+        n_fl_tree = flat_ops.unpack_stacked(lay, n_fl)
+        for a, b in zip(jax.tree.leaves(n_per), jax.tree.leaves(n_fl_tree)):
+            np.testing.assert_array_equal(
+                np.asarray(a).reshape(np.shape(b)), np.asarray(b)
+            )
+
+
+@pytest.mark.parametrize("arch", MANY_LEAF_ARCHS)
+@pytest.mark.parametrize("error_feedback", [True, False])
+def test_topk_flat_global_budget_on_arch(arch, error_feedback):
+    """Documented-equivalent semantics: ONE global keep budget
+    ``ceil(f * total)`` spent on the globally largest coordinates, vs the
+    per-leaf codec's leaf-quantised budgets."""
+    fraction = 0.01
+    params, deltas = arch_delta_tree(arch)
+    fl = compression.make_topk(
+        fraction, error_feedback=error_feedback, layout="flat"
+    )
+    state = fl.init(params, 2)
+    lay = flat_ops.make_layout(params)
+    y = flat_ops.pack_stacked(lay, deltas)
+    out, new_state = fl.apply_flat(y, state, lay)
+    out_np = np.asarray(out)
+    k = math.ceil(fraction * lay.total)
+    for c in range(2):
+        row = np.asarray(y)[c]
+        kept = out_np[c] != 0
+        # Budget: exactly k kept (random gaussians don't tie), global.
+        assert k <= kept.sum() <= k + 8
+        # Every kept coordinate is >= every dropped REAL coordinate.
+        dropped = ~kept
+        dropped[lay.total :] = False  # padding is not a real coordinate
+        assert np.abs(row[kept]).min() >= np.abs(row[dropped]).max() - 1e-6
+    if error_feedback:
+        # Mass conservation on the flat buffer.
+        np.testing.assert_allclose(
+            out_np + np.asarray(new_state), np.asarray(y), atol=1e-6
+        )
+        # Padding region of the residual stays zero.
+        np.testing.assert_array_equal(
+            np.asarray(new_state)[:, lay.total :], 0.0
+        )
+
+
+# --------------------------------------------- round-step bit parity (mlp)
+def _mlp_setup(kind, layout, error_feedback=True):
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=4,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(dataset="synthetic", batch_size=8),
+        fed=FedConfig(
+            num_clients=4,
+            compression=kind,
+            topk_fraction=0.1,
+            error_feedback=error_feedback,
+            delta_layout=layout,
+        ),
+        steps_per_round=3,
+    )
+    model = models.create(cfg.model, num_classes=cfg.num_classes)
+    comp = compression.make_compressor(cfg.fed)
+    state = round_lib.init_state(
+        model, cfg, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32), comp
+    )
+    step = jax.jit(round_lib.make_round_step(model, cfg, compressor=comp))
+    rng = np.random.default_rng(0)
+    n, s, b = 4, 3, 8
+    batch = round_lib.RoundBatch(
+        x=jnp.asarray(rng.normal(size=(n, s, b, 6)).astype(np.float32)),
+        y=jnp.asarray(rng.integers(0, 4, size=(n, s, b)).astype(np.int32)),
+        step_mask=jnp.ones((n, s), bool),
+        weights=jnp.ones((n,), jnp.float32),
+        alive=jnp.ones((n, ), bool),
+    )
+    return state, step, batch
+
+
+@pytest.mark.parametrize(
+    "kind,error_feedback",
+    [("none", True), ("int8", True), ("int8", False)],
+)
+def test_round_step_layouts_bit_identical(kind, error_feedback):
+    results = {}
+    for layout in ("per_leaf", "flat"):
+        state, step, batch = _mlp_setup(kind, layout, error_feedback)
+        for _ in range(3):
+            state, m = step(state, batch)
+        results[layout] = (state, m)
+    s_pl, m_pl = results["per_leaf"]
+    s_fl, m_fl = results["flat"]
+    for a, b in zip(jax.tree.leaves(s_pl.params), jax.tree.leaves(s_fl.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(m_pl.loss) == float(m_fl.loss)
+
+
+def test_round_step_topk_flat_trains():
+    state, step, batch = _mlp_setup("topk", "flat")
+    losses = []
+    for _ in range(8):
+        state, m = step(state, batch)
+        losses.append(float(m.loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # Flat residual state: ONE [clients, P] buffer, nonzero after rounds.
+    assert isinstance(state.comp_state, jnp.ndarray)
+    assert state.comp_state.ndim == 2
+    assert float(jnp.abs(state.comp_state).max()) > 0
+
+
+def test_round_step_rejects_layout_mismatch():
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=4,
+        data=DataConfig(dataset="synthetic"),
+        fed=FedConfig(num_clients=2, compression="topk", delta_layout="flat"),
+    )
+    model = models.create("mlp", num_classes=4)
+    per_leaf = compression.make_topk(0.1)
+    with pytest.raises(ValueError, match="flat"):
+        round_lib.make_round_step(model, cfg, compressor=per_leaf)
+    flat_comp = compression.make_topk(0.1, layout="flat")
+    cfg2 = RoundConfig(
+        model="mlp",
+        num_classes=4,
+        data=DataConfig(dataset="synthetic"),
+        fed=FedConfig(num_clients=2, compression="topk", delta_layout="per_leaf"),
+    )
+    with pytest.raises(ValueError, match="per_leaf"):
+        round_lib.make_round_step(model, cfg2, compressor=flat_comp)
+
+
+# ----------------------------------------------------------- mesh topology
+@pytest.mark.parametrize("kind", ["none", "int8"])
+def test_mesh_flat_vs_per_leaf_bit_identical(eight_devices, kind):
+    """The layout-parity invariant holds ON THE MESH too: shard_map rounds
+    with delta_layout='flat' produce bit-identical params to per_leaf at the
+    same topology (comp_state shards as one [clients, P] buffer)."""
+    from fedtpu.core.engine import Federation
+    from fedtpu.parallel import client_mesh
+
+    def build(layout):
+        cfg = RoundConfig(
+            model="mlp",
+            num_classes=10,
+            opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+            data=DataConfig(
+                dataset="synthetic", batch_size=8, partition="iid",
+                num_examples=256,
+            ),
+            fed=FedConfig(num_clients=8, compression=kind, delta_layout=layout),
+            steps_per_round=2,
+        )
+        return Federation(cfg, seed=0, mesh=client_mesh(8, cfg.mesh_axis))
+
+    f_pl, f_fl = build("per_leaf"), build("flat")
+    for _ in range(2):
+        f_pl.step()
+        f_fl.step()
+    for a, b in zip(
+        jax.tree.leaves(f_pl.state.params), jax.tree.leaves(f_fl.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------- dispatch budget
+def test_flat_dispatch_count_within_budget():
+    """Acceptance gate: the flat codec+aggregation stage traces to <= 10%
+    of the per-leaf stage's jaxpr equations on a many-leaf model (trace
+    only — nothing executes)."""
+    from fedtpu.core.round import _mean_over_clients
+
+    params, deltas = arch_delta_tree("densenet_cifar")
+    lay = flat_ops.make_layout(params)
+    weights = jnp.ones((2,), jnp.float32)
+
+    for make_per, make_fl in [
+        (
+            lambda: compression.make_topk(0.01),
+            lambda: compression.make_topk(0.01, layout="flat"),
+        ),
+        (
+            lambda: compression.make_int8(),
+            lambda: compression.make_int8(layout="flat"),
+        ),
+    ]:
+        per, fl = make_per(), make_fl()
+        s_per, s_fl = per.init(params, 2), fl.init(params, 2)
+
+        def per_stage(d, s):
+            out, new = per.apply(d, s)
+            return _mean_over_clients(out, weights, None)[0], new
+
+        def fl_stage(y, s):
+            out, new = fl.apply_flat(y, s, lay)
+            return _mean_over_clients(out, weights, None)[0], new
+
+        y0 = jax.eval_shape(lambda d: flat_ops.pack_stacked(lay, d), deltas)
+        n_per = len(jax.make_jaxpr(per_stage)(deltas, s_per).eqns)
+        n_fl = len(jax.make_jaxpr(fl_stage)(y0, s_fl).eqns)
+        assert n_fl <= 0.10 * n_per, (n_fl, n_per)

@@ -11,7 +11,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# Environment gate, not a correctness gate: the container has no
+# `hypothesis` wheel and installs are not allowed; without this guard the
+# module is a COLLECTION ERROR, which poisons the tier-1 dots count. With
+# it, the module is an honest skip wherever hypothesis is absent and runs
+# in full wherever it exists.
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fedtpu.core.round import _dp_clip, _robust_over_clients
 from fedtpu.data import partition

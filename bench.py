@@ -360,6 +360,177 @@ def _compression_microbench():
     }
 
 
+def _server_pipeline_microbench():
+    """``server_pipeline_post_barrier``: barrier vs stream server collect.
+
+    Measures what the distributed server does AFTER the last StartTrain
+    reply lands (the post-barrier gap the streaming pipeline exists to
+    shrink) plus the per-reply collect-side work, on real wire payloads
+    through the real ``PrimaryServer`` machinery — no gRPC, the replies are
+    pre-encoded ``int8_flat`` records:
+
+    - ``barrier``: per-leaf template decode per reply (collect side), then
+      leaf-by-leaf stacking of every client tree + the jitted
+      ``_aggregate`` (host->device transfer inside the dispatch) after the
+      barrier — the reference-shaped path.
+    - ``stream``: decode-into-row + per-row device_put + in-place device
+      buffer write per reply (collect side, overlapped with network wait in
+      real rounds), then ONE fused ``_finalize_stream`` after the barrier.
+
+    Also reports peak host delta memory (decoded per-leaf trees for every
+    client vs one flat ``[clients, P]`` buffer) and checks the two paths'
+    aggregated params are bit-identical. Run via
+    ``python bench.py --server-pipeline-microbench``; prints one JSON line
+    and writes ``artifacts/SERVER_PIPELINE_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.transport import sparse
+    from fedtpu.transport.federation import PrimaryServer, _model_template
+
+    model_names = os.environ.get(
+        "FEDTPU_SPB_MODELS", "densenet_cifar,smallcnn"
+    ).split(",")
+    clients = int(os.environ.get("FEDTPU_SPB_CLIENTS", "64"))
+    reps = int(os.environ.get("FEDTPU_SPB_REPS", "3"))
+
+    def timed(fn):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    models = {}
+    for name in model_names:
+        name = name.strip()
+        cfg = RoundConfig(
+            model=name,
+            num_classes=10,
+            data=DataConfig(dataset="cifar10"),
+            fed=FedConfig(
+                num_clients=clients,
+                delta_layout="flat",
+                server_pipeline="stream",
+            ),
+        )
+        primary = PrimaryServer(cfg, [])
+        lay = primary._flat_layout
+        params_t, stats_t = _model_template(primary.model, cfg)
+        template = {"params": params_t, "batch_stats": stats_t}
+        rng = np.random.default_rng(0)
+        delta = jax.tree.map(
+            lambda s: rng.normal(size=s.shape).astype(np.float32) * 1e-2,
+            template,
+        )
+        payload, _ = sparse.encode_int8_flat(
+            delta, extra={"num_examples": np.float32(6.0)}
+        )
+        weights = jnp.ones((clients,), jnp.float32)
+        global_tree = {
+            "params": primary.params, "batch_stats": primary.batch_stats
+        }
+
+        # ---- collect-side work, per reply --------------------------------
+        decode_tree_s = timed(lambda: sparse.decode(payload, template))
+        tree = sparse.decode(payload, template)[0]
+        trees = [tree] * clients
+
+        host_row = np.zeros((lay.padded,), np.float32)
+        dev_buf = [jnp.zeros((clients, lay.padded), jnp.float32)]
+
+        def stream_reply(i=0):
+            sparse.decode_into_row(payload, lay.sizes, host_row)
+            dev_buf[0] = primary._set_row(
+                dev_buf[0], jax.device_put(host_row), i
+            )
+            jax.block_until_ready(dev_buf[0])
+
+        stream_reply()  # compile _set_row before timing
+        decode_row_s = timed(stream_reply)
+        for i in range(clients):
+            stream_reply(i)
+
+        # ---- post-barrier gap: last reply -> new global ------------------
+        def barrier_post():
+            stacked = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *trees
+            )
+            out, _ = primary._aggregate(
+                global_tree, stacked, weights,
+                primary._server_opt_state, jnp.asarray(0, jnp.int32),
+            )
+            jax.block_until_ready(out["params"])
+            return out
+
+        def stream_post():
+            out, _ = primary._finalize_stream(
+                global_tree, dev_buf[0], weights,
+                primary._server_opt_state,
+            )
+            jax.block_until_ready(out["params"])
+            return out
+
+        out_b = barrier_post()  # compile both before timing
+        out_s = stream_post()
+        bit_identical = all(
+            bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(
+                jax.tree.leaves(out_b["params"]),
+                jax.tree.leaves(out_s["params"]),
+            )
+        )
+        barrier_post_s = timed(barrier_post)
+        stream_post_s = timed(stream_post)
+
+        tree_bytes = sum(
+            np.asarray(l).nbytes for l in jax.tree.leaves(tree)
+        )
+        models[name] = {
+            "num_leaves": lay.num_leaves,
+            "num_params": lay.total,
+            "padded_row": lay.padded,
+            "barrier": {
+                "decode_ms_per_reply": round(decode_tree_s * 1e3, 3),
+                "post_barrier_s": round(barrier_post_s, 4),
+                "host_delta_bytes": tree_bytes * clients,
+            },
+            "stream": {
+                "decode_h2d_ms_per_reply": round(decode_row_s * 1e3, 3),
+                "post_barrier_s": round(stream_post_s, 4),
+                "host_delta_bytes": int(clients * lay.padded * 4),
+            },
+            "post_barrier_speedup": round(barrier_post_s / stream_post_s, 2),
+            "mean_bit_identical": bit_identical,
+        }
+
+    headline = model_names[0].strip()
+    result = {
+        "metric": "server_pipeline_post_barrier",
+        "unit": "x (barrier / stream post-barrier gap, last-reply -> new-global)",
+        # Acceptance headline: the speedup on the first (many-leaf) model.
+        "value": models[headline]["post_barrier_speedup"],
+        "headline_model": headline,
+        "num_clients": clients,
+        "models": models,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "SERVER_PIPELINE_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
@@ -463,6 +634,9 @@ def _print_diag(error: str) -> None:
 def main():
     if "--compression-microbench" in sys.argv:
         print(json.dumps(_compression_microbench()))
+        return
+    if "--server-pipeline-microbench" in sys.argv:
+        print(json.dumps(_server_pipeline_microbench()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

@@ -138,6 +138,20 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
     add_compression_flags(p)
     p.add_argument(
+        "--server-pipeline",
+        default="auto",
+        choices=["auto", "barrier", "stream"],
+        help="how the distributed server consumes StartTrain replies: "
+        "barrier = decode into per-leaf host pytrees and stack/transfer/"
+        "aggregate after the LAST reply (parity path); stream = decode "
+        "each reply into its row of one flat [clients, P] buffer and ship "
+        "it to the device as it arrives, leaving a single fused finalize "
+        "post-barrier (mean aggregation bit-identical to barrier; "
+        "requires --aggregator mean, no DP). auto = stream for "
+        "--delta-layout flat when the combination supports it "
+        "(see docs/PERF_ANALYSIS.md). Ignored by the simulated engine",
+    )
+    p.add_argument(
         "--aggregator",
         default="mean",
         choices=["mean", "median", "trimmed_mean", "krum"],
@@ -229,6 +243,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             compression=compression,
             topk_fraction=getattr(args, "topk_fraction", 0.01),
             delta_layout=getattr(args, "delta_layout", "per_leaf"),
+            server_pipeline=getattr(args, "server_pipeline", "auto"),
             aggregator=getattr(args, "aggregator", "mean"),
             trim_fraction=getattr(args, "trim_fraction", 0.1),
             server_optimizer=getattr(args, "server_optimizer", "none"),

@@ -37,6 +37,13 @@ def main(argv=None) -> int:
         help="comma-separated client registry (reference default)",
     )
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument(
+        "--metrics", default=None,
+        help="JSONL metrics path: one structured record per round "
+        "(participants, wire bytes, and the collect/decode/H2D/aggregate "
+        "phase timing the streaming pipeline reports — see "
+        "--server-pipeline)",
+    )
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
@@ -121,24 +128,35 @@ def main(argv=None) -> int:
                     primary.install_state(tree)
                     start_round = r + 1
                     logging.info("resumed global model from round %d", r)
+        from fedtpu.utils.metrics import MetricsLogger
+
+        metrics = MetricsLogger(path=args.metrics) if args.metrics else None
+
         def on_round(r: int, rec: dict) -> None:
+            if metrics is not None:
+                metrics.log(start_round + r, **rec)
             if ckpt is not None:
                 ckpt.save(start_round + r, primary.state_tree())
 
         # run() (not a bare round() loop) so the heartbeat recovery thread
         # and the backup liveness pinger actually run in the CLI deployment.
-        if args.async_updates:
-            primary.run_async(
-                num_updates=args.async_updates,
-                buffer_k=args.buffer_k,
-                staleness_power=args.staleness_power,
-                staleness_damping=args.staleness_damping == "on",
-                on_update=on_round,
-            )
-        else:
-            primary.run(
-                num_rounds=cfg.fed.num_rounds - start_round, on_round=on_round
-            )
+        try:
+            if args.async_updates:
+                primary.run_async(
+                    num_updates=args.async_updates,
+                    buffer_k=args.buffer_k,
+                    staleness_power=args.staleness_power,
+                    staleness_damping=args.staleness_damping == "on",
+                    on_update=on_round,
+                )
+            else:
+                primary.run(
+                    num_rounds=cfg.fed.num_rounds - start_round,
+                    on_round=on_round,
+                )
+        finally:
+            if metrics is not None:
+                metrics.close()
         return 0
 
     backup = BackupServer(

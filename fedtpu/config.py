@@ -157,6 +157,65 @@ class FedConfig:
     # sensitivity bound clip/n actually holds.
     dp_clip_norm: float = 0.0
     dp_noise_multiplier: float = 0.0
+    # HOW the distributed server (fedtpu.transport.federation.PrimaryServer)
+    # consumes StartTrain replies.
+    #   "barrier": decode every reply into per-leaf host pytrees, stack
+    #     leaf-by-leaf after the LAST reply, then transfer + aggregate in
+    #     one jitted program (the original path; per-leaf parity reference).
+    #   "stream": decode each reply directly into its row of one
+    #     preallocated flat [clients, P] buffer and ship it to the device
+    #     as it arrives (decode + H2D overlap the remaining clients'
+    #     network wait); the post-barrier work is a single fused
+    #     mean/unpack/server-opt finalize over the already-resident rows.
+    #     Mean aggregation is bit-identical to "barrier" (the finalize runs
+    #     the same order-stable stacked reduce — see
+    #     docs/PERF_ANALYSIS.md). Requires aggregator='mean' and no DP
+    #     clipping (validated in resolve_server_pipeline).
+    #   "auto" (default): "stream" whenever the flat delta layout is on and
+    #     the combination supports it, else "barrier".
+    # Engine-side (simulated) federation ignores this knob: there is no
+    # network edge to overlap.
+    server_pipeline: str = "auto"  # auto | barrier | stream
+
+
+def resolve_server_pipeline(fed: FedConfig) -> str:
+    """Resolve ``FedConfig.server_pipeline`` to ``"barrier"`` or
+    ``"stream"``, naming WHY a combination cannot stream.
+
+    The streaming collect path folds rows into the aggregate as they
+    arrive, so it only supports combines that are per-coordinate sums:
+    the (weighted) mean. Robust aggregators and DP clipping need every
+    client's full row on device at once — they stay on the stacked
+    barrier path.
+    """
+    if fed.server_pipeline not in ("auto", "barrier", "stream"):
+        raise ValueError(
+            f"unknown server_pipeline {fed.server_pipeline!r}; "
+            "have auto | barrier | stream"
+        )
+    streamable = fed.aggregator == "mean" and fed.dp_clip_norm == 0
+    if fed.server_pipeline == "stream":
+        if fed.aggregator != "mean":
+            raise ValueError(
+                f"server_pipeline='stream' cannot compose with "
+                f"aggregator={fed.aggregator!r}: median/trimmed_mean/krum "
+                "are not per-coordinate sums, so they need every client "
+                "row at once — use server_pipeline='barrier' (the stacked "
+                "[clients, ...] path)."
+            )
+        if fed.dp_clip_norm > 0:
+            raise ValueError(
+                "server_pipeline='stream' cannot compose with DP clipping: "
+                "DP-FedAvg clips each client's full delta before the "
+                "combine, so rows cannot fold into a running aggregate — "
+                "use server_pipeline='barrier'."
+            )
+        return "stream"
+    if fed.server_pipeline == "barrier":
+        return "barrier"
+    # auto: stream is the default for the flat delta layout (the perf
+    # config the layout exists for); per_leaf keeps the parity path.
+    return "stream" if (fed.delta_layout == "flat" and streamable) else "barrier"
 
 
 @dataclasses.dataclass(frozen=True)

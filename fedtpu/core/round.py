@@ -308,6 +308,25 @@ def _dp_noise(
     return jax.tree_util.tree_unflatten(treedef, noised)
 
 
+def flat_weighted_mean(rows: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """Weighted mean over a ``[clients, P]`` flat-row buffer — the streaming
+    server pipeline's post-barrier combine (one fused reduce over rows that
+    are already device-resident, shipped row-by-row as replies arrived).
+
+    Same per-coordinate math and the same order-stable stacked axis-0
+    reduce as :func:`_mean_over_clients` / ``PrimaryServer._aggregate_impl``
+    on the equivalent per-leaf tree, so the result is BIT-IDENTICAL to the
+    barrier path's mean (the parity the stream tests pin). A running
+    row-by-row accumulator would NOT be: a sequential f32 left fold differs
+    from XLA's vectorised reduction in the last ulp on most coordinates
+    (measured — see docs/PERF_ANALYSIS.md), which is why the stream path
+    keeps the rows and reduces them in one op instead of folding eagerly.
+    """
+    total = jnp.maximum(jnp.sum(weights), 1e-9)
+    w = weights.reshape((-1,) + (1,) * (rows.ndim - 1)).astype(rows.dtype)
+    return jnp.sum(rows * w, axis=0) / total.astype(rows.dtype)
+
+
 def _mean_over_clients(stacked: Pytree, weights: jnp.ndarray, axis_name):
     """Masked weighted mean over the clients axis.
 

@@ -158,6 +158,26 @@ def pack(layout: FlatLayout, tree: Pytree) -> jnp.ndarray:
     return flat
 
 
+def pack_row_host(
+    layout: FlatLayout, tree: Pytree, out: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Host-side (numpy) twin of :func:`pack`: a single pytree into a
+    ``[padded]`` f32 row, written into ``out`` when given (the streaming
+    server's preallocated row buffer) so no intermediate concatenation is
+    materialised. ``out[total:]`` is left untouched (callers keep the pad
+    region zero)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != layout.num_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, layout expects {layout.num_leaves}"
+        )
+    if out is None:
+        out = np.zeros((layout.padded,), np.float32)
+    for leaf, off, size in zip(leaves, layout.offsets, layout.sizes):
+        out[off : off + size] = np.asarray(leaf, np.float32).ravel()
+    return out
+
+
 def unpack(layout: FlatLayout, flat: jnp.ndarray) -> Pytree:
     """``[padded]`` row -> pytree (original dtypes, padding dropped)."""
     leaves = [

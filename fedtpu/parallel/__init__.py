@@ -6,7 +6,7 @@ from fedtpu.parallel.sharded import (
     shard_batch,
     shard_state,
 )
-from fedtpu.parallel.dryrun import dryrun_multichip
+from fedtpu.parallel.dryrun import dryrun_multichip, dryrun_multichip_light
 from fedtpu.parallel import multihost
 
 __all__ = [
@@ -20,4 +20,5 @@ __all__ = [
     "shard_batch",
     "shard_state",
     "dryrun_multichip",
+    "dryrun_multichip_light",
 ]

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedtpu import models as model_zoo
-from fedtpu.config import RoundConfig
+from fedtpu.config import RoundConfig, resolve_server_pipeline
 from fedtpu.core.client import make_eval_fn, make_local_update
 from fedtpu.core import optim
 from fedtpu.data import load, dataset_info
@@ -383,6 +383,30 @@ class PrimaryServer:
             PrimaryPinger(self._ping_backup) if self.backup_stub else None
         )
         self._aggregate = jax.jit(self._aggregate_impl)
+        # Streaming collect pipeline (server_pipeline="stream", resolved
+        # from the config — "auto" streams for the flat delta layout):
+        # replies decode into rows of ONE flat [clients, P] buffer and ship
+        # to the device as they arrive, so the post-barrier work is a
+        # single fused finalize instead of per-leaf decode/stack/transfer
+        # behind the slowest client. See round() and docs/PERF_ANALYSIS.md.
+        self.server_pipeline = resolve_server_pipeline(cfg.fed)
+        if self.server_pipeline == "stream":
+            from fedtpu.ops import flat as flat_ops
+
+            params_t, stats_t = _model_template(self.model, cfg)
+            self._flat_layout = flat_ops.make_layout(
+                {"params": params_t, "batch_stats": stats_t}
+            )
+            # Donated row write: XLA aliases input and output, so each
+            # arriving row is an in-place update of the device buffer, not
+            # a [clients, P] copy.
+            self._set_row = jax.jit(
+                lambda buf, row, i: jax.lax.dynamic_update_slice(
+                    buf, row[None], (i, 0)
+                ),
+                donate_argnums=0,
+            )
+            self._finalize_stream = jax.jit(self._finalize_stream_impl)
         self.history: List[dict] = []
         self._did_initial_sync = False
         # Straggler StartTrain threads still in flight from earlier rounds,
@@ -464,6 +488,31 @@ class PrimaryServer:
                     seed=self.cfg.data.seed ^ 0x5F5E5F,
                 ),
             )
+        new_params, new_opt = server_opt_lib.apply(
+            self._server_opt, global_tree["params"], deltas["params"], opt_state
+        )
+        new_stats = jax.tree.map(
+            lambda g, d: g + d, global_tree["batch_stats"], deltas["batch_stats"]
+        )
+        return {"params": new_params, "batch_stats": new_stats}, new_opt
+
+    def _finalize_stream_impl(self, global_tree, rows, weights, opt_state):
+        """Post-barrier finalize of the streaming pipeline: ONE fused
+        program over the device-resident ``[participants, P]`` row buffer —
+        weighted mean, unpack to the delta pytree, server-optimizer step,
+        BN-stats add. The mean is :func:`fedtpu.core.round.flat_weighted_mean`,
+        whose stacked axis-0 reduce is bit-identical to
+        :meth:`_aggregate_impl`'s per-leaf mean (the stream/barrier parity
+        the tests pin); everything downstream is the same per-leaf math.
+        Robust aggregators and DP never reach here — config validation
+        routes them to the barrier path (fedtpu.config.resolve_server_pipeline).
+        """
+        from fedtpu.core import server_opt as server_opt_lib
+        from fedtpu.core.round import flat_weighted_mean
+        from fedtpu.ops import flat as flat_ops
+
+        mean_row = flat_weighted_mean(rows, weights)
+        deltas = flat_ops.unpack(self._flat_layout, mean_row)
         new_params, new_opt = server_opt_lib.apply(
             self._server_opt, global_tree["params"], deltas["params"], opt_state
         )
@@ -675,9 +724,28 @@ class PrimaryServer:
                     }
                 return cache["d"]
 
-        # results[client] = (delta_tree, num_examples)
+        # results[client] = (delta_tree | row_index, num_examples)
         results: Dict[str, tuple] = {}
         bytes_up = [0]  # client -> server payload bytes this round
+        stream = self.server_pipeline == "stream"
+        # Per-round phase timing (satellite of the streaming pipeline):
+        # decode / H2D are summed across clients under the lock; collect and
+        # the post-barrier gap are wall-clock marks in this thread. Reported
+        # on the round record so the overlap win shows up in ordinary run
+        # logs, not just the microbench.
+        phase = {"decode_s": 0.0, "h2d_s": 0.0}
+        # Streaming collect state: one preallocated host row per launched
+        # client (decode target) and ONE device [launch, P] buffer that
+        # arriving rows are written into in place (donated
+        # dynamic_update_slice), so by the time the last reply lands the
+        # whole delta block is already device-resident. All of it is
+        # PER-ROUND (like `results`): a straggler from an earlier round
+        # still holds references to ITS round's buffers, so its late write
+        # can never corrupt this round's rows.
+        row_of: Dict[str, int] = {}
+        host_rows: List[np.ndarray] = []
+        dev_buf: List[Any] = []
+        stream_lock = threading.Lock()
 
         def train_one(rank: int, client: str) -> None:
             try:
@@ -688,10 +756,54 @@ class PrimaryServer:
                 data = reply.message
                 with cache_lock:
                     bytes_up[0] += len(data)
-                if sparse.is_sparse_payload(data):
+                if stream:
+                    # Decode straight into this client's row — no per-leaf
+                    # template trees, no later leaf-by-leaf stacking.
+                    row = host_rows[0][row_of[client]]
+                    t0 = time.monotonic()
+                    if sparse.is_sparse_payload(data):
+                        extra = sparse.decode_into_row(
+                            data, self._flat_layout.sizes, row
+                        )
+                    else:
+                        # Dense full weights -> delta against the round's
+                        # global, written into the row leaf slices.
+                        extra = wire.decode_into_row(
+                            data,
+                            _payload_template(self.model, cfg),
+                            global_host(),
+                            row,
+                        )
+                    t1 = time.monotonic()
+                    # Ship the row NOW: the transfer (and the in-place
+                    # device-buffer write) overlaps the remaining clients'
+                    # network wait instead of queueing behind the barrier.
+                    # A deadline straggler landing AFTER the round closed
+                    # its buffer (the pop in the finalize below) skips the
+                    # device write: its reply is excluded from this round
+                    # anyway, and writing would donate a buffer handle the
+                    # finalize may still be reading.
+                    dev_row = jax.device_put(row)
+                    with stream_lock:
+                        if dev_buf:
+                            dev_buf[0] = self._set_row(
+                                dev_buf[0], dev_row, row_of[client]
+                            )
+                    t2 = time.monotonic()
+                    with cache_lock:
+                        phase["decode_s"] += t1 - t0
+                        phase["h2d_s"] += t2 - t1
+                    results[client] = (
+                        row_of[client], float(extra["num_examples"])
+                    )
+                elif sparse.is_sparse_payload(data):
+                    t0 = time.monotonic()
                     deltas, extra = sparse.decode(data, delta_template())
+                    with cache_lock:
+                        phase["decode_s"] += time.monotonic() - t0
                     results[client] = (deltas, float(extra["num_examples"]))
                 else:
+                    t0 = time.monotonic()
                     tree = wire.decode(
                         data, _payload_template(self.model, cfg)
                     )
@@ -703,6 +815,8 @@ class PrimaryServer:
                          "batch_stats": tree["batch_stats"]},
                         global_host(),
                     )
+                    with cache_lock:
+                        phase["decode_s"] += time.monotonic() - t0
                     results[client] = (delta, float(tree["num_examples"]))
             except grpc.RpcError as e:
                 log.warning(
@@ -754,6 +868,12 @@ class PrimaryServer:
         # engine's alive-mask semantics) and run_async, which already
         # assigns registry-order ranks.
         rank_of = {c: i for i, c in enumerate(self.registry.clients)}
+        if stream and launch:
+            row_of.update({c: i for i, c in enumerate(launch)})
+            padded = self._flat_layout.padded
+            host_rows.append(np.zeros((len(launch), padded), np.float32))
+            dev_buf.append(jnp.zeros((len(launch), padded), jnp.float32))
+        t_launch = time.monotonic()
         threads = {
             client: threading.Thread(
                 target=train_one, args=(rank_of[client], client)
@@ -778,6 +898,7 @@ class PrimaryServer:
                     "round deadline %.1fs hit; aggregating without %s",
                     self.round_deadline_s, stragglers,
                 )
+        t_barrier = time.monotonic()
         # Merge this round's threads over the surviving prior entries: a
         # straggler launched two rounds ago can still be running even though
         # it was never in THIS round's `threads` — dropping it would hand
@@ -799,25 +920,52 @@ class PrimaryServer:
         }
         if completed:
             order = [c for c in active if c in completed]
-            stacked = jax.tree.map(
-                lambda *leaves: jnp.stack(leaves),
-                *[completed[c][0] for c in order],
-            )
             if cfg.fed.weighted:
                 weights = jnp.asarray(
                     [completed[c][1] for c in order], jnp.float32
                 )
             else:
                 weights = jnp.ones((len(order),), jnp.float32)
-            new_global, self._server_opt_state = self._aggregate(
-                {"params": self.params, "batch_stats": self.batch_stats},
-                stacked,
-                weights,
-                self._server_opt_state,
-                jnp.asarray(self._round_counter, jnp.int32),
-            )
+            if stream:
+                # The rows are already device-resident (shipped on arrival)
+                # — the only post-barrier work is ONE fused finalize. Close
+                # the round's buffer under the lock first: a deadline
+                # straggler must not donate-invalidate the handle we are
+                # about to read. When a launched client failed or straggled,
+                # gather the surviving rows so the reduce runs over EXACTLY
+                # the rows the barrier path would stack (same [k, P] shape
+                # -> the same order-stable reduce -> bit parity).
+                with stream_lock:
+                    rows = dev_buf.pop()
+                if order != launch:
+                    rows = rows[
+                        jnp.asarray([row_of[c] for c in order], jnp.int32)
+                    ]
+                new_global, self._server_opt_state = self._finalize_stream(
+                    {"params": self.params, "batch_stats": self.batch_stats},
+                    rows,
+                    weights,
+                    self._server_opt_state,
+                )
+            else:
+                stacked = jax.tree.map(
+                    lambda *leaves: jnp.stack(leaves),
+                    *[completed[c][0] for c in order],
+                )
+                new_global, self._server_opt_state = self._aggregate(
+                    {"params": self.params, "batch_stats": self.batch_stats},
+                    stacked,
+                    weights,
+                    self._server_opt_state,
+                    jnp.asarray(self._round_counter, jnp.int32),
+                )
             self.params = new_global["params"]
             self.batch_stats = new_global["batch_stats"]
+            # Block for the timing marks: the broadcast below needs the
+            # values host-side moments later anyway (model_bytes), so this
+            # costs nothing and makes the post-barrier gap honest.
+            jax.block_until_ready(self.params)
+        t_done = time.monotonic()
         # Advance the lineage counter BEFORE replication: the replica must
         # carry the next round's index, or a promoted backup would redraw
         # this round's DP noise key against a different aggregate.
@@ -899,6 +1047,17 @@ class PrimaryServer:
             # (src/client.py:21).
             "bytes_up": bytes_up[0],
             "bytes_down": bytes_down[0],
+            "pipeline": self.server_pipeline,
+            # Phase timing: collect is launch->last join; decode/h2d are
+            # summed per-client (overlapped with network wait under
+            # "stream", so they can exceed nothing of the wall clock);
+            # post_barrier is the last-reply -> new-global gap the
+            # streaming pipeline exists to shrink.
+            "t_collect_s": round(t_barrier - t_launch, 6),
+            "t_decode_s": round(phase["decode_s"], 6),
+            "t_h2d_s": round(phase["h2d_s"], 6),
+            "t_aggregate_s": round(t_done - t_barrier, 6),
+            "t_post_barrier_s": round(t_done - t_barrier, 6),
         }
         self.history.append(rec)
         return rec

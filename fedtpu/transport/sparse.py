@@ -339,6 +339,90 @@ def _decode_flat(body: dict, leaves, treedef) -> Pytree:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def decode_into_row(
+    data: bytes, sizes, out: np.ndarray
+) -> dict:
+    """Decode a sparse payload DIRECTLY into a preallocated f32 row.
+
+    The streaming server pipeline's decode: no per-leaf template trees, no
+    ``tree_unflatten``, no per-leaf reshape/astype — the record's values
+    land straight in ``out[: total]``, the row of the server's
+    ``[clients, P]`` flat buffer (``fedtpu.ops.flat`` coordinate order,
+    which both ends derive from the shared model definition). ``sizes`` is
+    the per-leaf scalar-count table (``FlatLayout.sizes``). Every real
+    coordinate of ``out`` is written (kept values, zeros for dropped top-k
+    coordinates); ``out[total:]`` — the lane padding — is never touched, so
+    a zero-initialised reusable buffer stays pad-clean across rounds.
+
+    Returns the record's ``extra`` dict. Raises :class:`WireError` on any
+    template mismatch or out-of-range index, exactly like :func:`decode`.
+    """
+    body = serialization.msgpack_restore(_unframe(data))
+    sizes = [int(s) for s in sizes]
+    total = sum(sizes)
+    if out.shape[0] < total or out.dtype != np.float32:
+        raise ValueError(
+            f"row buffer too small or not f32: {out.shape} {out.dtype} "
+            f"for {total} coordinates"
+        )
+    kind = body.get("kind")
+    if kind in ("topk_flat", "int8_flat"):
+        wire_sizes = np.asarray(body["sizes"], np.int64)
+        if len(wire_sizes) != len(sizes):
+            raise WireError(
+                f"flat payload has {len(wire_sizes)} leaves, layout has "
+                f"{len(sizes)}"
+            )
+        for n, m in zip(wire_sizes, sizes):
+            if int(n) != m:
+                raise WireError("flat leaf size mismatch with layout")
+        if kind == "topk_flat":
+            idx = np.ascontiguousarray(body["idx"], np.int32)
+            # Untrusted wire data: the scatter below writes unchecked.
+            if idx.size and (idx.min() < 0 or idx.max() >= total):
+                raise WireError("sparse index out of range")
+            out[:total] = 0.0
+            out[idx] = np.asarray(body["vals"], np.float32)
+        else:  # int8_flat
+            codes = np.ascontiguousarray(body["codes"], np.int8)
+            if codes.size != total:
+                raise WireError("int8_flat code block size mismatch")
+            scales = np.asarray(body["scales"], np.float32)
+            if scales.size != len(sizes):
+                raise WireError("int8_flat scale table size mismatch")
+            off = 0
+            for n, s in zip(sizes, scales):
+                out[off : off + n] = dequant_int8(
+                    codes[off : off + n], float(s), n
+                )
+                off += n
+        return dict(body.get("extra", {}))
+    # Per-leaf record kinds (topk | int8): one entry per leaf, scattered
+    # into the leaf's slice of the row.
+    if len(body["leaves"]) != len(sizes):
+        raise WireError(
+            f"sparse payload has {len(body['leaves'])} leaves, layout has "
+            f"{len(sizes)}"
+        )
+    off = 0
+    for i, n in enumerate(sizes):
+        e = body["leaves"][str(i)]
+        if int(e["size"]) != n:
+            raise WireError("sparse leaf size mismatch with layout")
+        if kind == "topk":
+            idx = np.ascontiguousarray(e["idx"], np.int32)
+            if idx.size and (idx.min() < 0 or idx.max() >= n):
+                raise WireError("sparse index out of range")
+            out[off : off + n] = 0.0
+            out[off + idx] = np.asarray(e["vals"], np.float32)
+        elif kind == "int8":
+            out[off : off + n] = dequant_int8(e["codes"], float(e["scale"]), n)
+        else:
+            raise WireError(f"unknown sparse kind {kind!r}")
+        off += n
+    return dict(body.get("extra", {}))
+
+
 def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
     """Reconstruct a dense delta pytree shaped like ``like``; returns
     (deltas, extra)."""

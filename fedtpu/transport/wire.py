@@ -115,6 +115,45 @@ def decode(data: bytes, like: Pytree) -> Pytree:
     return serialization.from_bytes(like, payload)
 
 
+def decode_into_row(
+    data: bytes, like: Pytree, base: Pytree, out: "np.ndarray"
+) -> dict:
+    """Decode a dense model payload and write its DELTA against ``base``
+    leaf-by-leaf into the preallocated f32 row ``out``.
+
+    The streaming server pipeline's dense fallback (unsynced clients and
+    ``compression='none'`` fleets ship full weights): the payload still
+    decodes through the msgpack template (flax restores *into* a
+    structure), but the per-leaf subtraction lands straight in the row's
+    leaf slices — no intermediate delta pytree, no per-leaf stacking later.
+    ``base`` is the host copy of the round's global model with the same
+    ``{"params", "batch_stats"}`` structure; leaf order (and therefore the
+    row coordinate order) is the shared ``jax.tree_util.tree_flatten``
+    order both ends derive from the model definition. Returns the payload's
+    non-model fields (e.g. ``num_examples``).
+    """
+    tree = decode(data, like)
+    packed = {k: tree[k] for k in ("params", "batch_stats")}
+    base_leaves = jax.tree_util.tree_leaves(base)
+    leaves = jax.tree_util.tree_leaves(packed)
+    if len(leaves) != len(base_leaves):
+        raise WireError(
+            f"payload has {len(leaves)} model leaves, base has "
+            f"{len(base_leaves)}"
+        )
+    off = 0
+    for leaf, b in zip(leaves, base_leaves):
+        n = int(np.size(b))
+        if int(np.size(leaf)) != n:
+            raise WireError("dense leaf size mismatch with base model")
+        out[off : off + n] = (
+            np.asarray(leaf, np.float32).ravel()
+            - np.asarray(b, np.float32).ravel()
+        )
+        off += n
+    return {k: v for k, v in tree.items() if k not in ("params", "batch_stats")}
+
+
 def payload_size(tree: Pytree) -> int:
     """Uncompressed wire size in bytes (sans header) — the number the
     reference inflates by 4/3 with base64 (``src/client.py:21``)."""

@@ -91,6 +91,46 @@ def test_compression_microbench_contract(bench, monkeypatch):
     )
 
 
+def test_server_pipeline_microbench_contract(bench, monkeypatch, tmp_path):
+    """--server-pipeline-microbench at a seconds-scale config: schema,
+    artifact emission, and the parity bit the acceptance criterion leans on
+    (the >=2x densenet/64-client gate itself is pinned by the committed
+    artifacts/SERVER_PIPELINE_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_SPB_MODELS", "smallcnn")
+    monkeypatch.setenv("FEDTPU_SPB_CLIENTS", "4")
+    monkeypatch.setenv("FEDTPU_SPB_REPS", "1")
+    result = bench._server_pipeline_microbench()
+    assert result["metric"] == "server_pipeline_post_barrier"
+    assert result["num_clients"] == 4
+    assert result["headline_model"] == "smallcnn"
+    m = result["models"]["smallcnn"]
+    assert m["padded_row"] % 128 == 0
+    assert m["barrier"]["post_barrier_s"] > 0
+    assert m["stream"]["post_barrier_s"] > 0
+    assert m["barrier"]["decode_ms_per_reply"] > 0
+    assert m["stream"]["decode_h2d_ms_per_reply"] > 0
+    assert m["barrier"]["host_delta_bytes"] > 0
+    assert m["stream"]["host_delta_bytes"] > 0
+    assert m["post_barrier_speedup"] == pytest.approx(
+        m["barrier"]["post_barrier_s"] / m["stream"]["post_barrier_s"],
+        rel=0.02,
+    )
+    # The two paths must agree BITWISE on the aggregated params — the
+    # stream pipeline is a perf change, never a numerics change.
+    assert m["mean_bit_identical"] is True
+    assert result["value"] == m["post_barrier_speedup"]
+    # Artifact written atomically next to the JSON line.
+    path = os.path.join(str(art), "SERVER_PIPELINE_MICROBENCH.json")
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
 def test_salvage_json_takes_last_valid_object(bench):
     text = 'garbage\n{"a": 1}\nnot json\n{"metric": "x", "value": 1}\ntrailing'
     assert bench._salvage_json(text) == '{"metric": "x", "value": 1}'

@@ -47,3 +47,16 @@ def test_cosine_diverges_from_reference_effective_lr():
 def test_unknown_schedule_raises():
     with pytest.raises(ValueError, match="unknown schedule"):
         OptimizerConfig(schedule="linear").lr_at(0)
+
+
+def test_server_pipeline_default_resolves_to_parity_path():
+    """The default config (per_leaf layout, auto pipeline) must keep the
+    barrier parity path; the flat layout streams by default."""
+    from fedtpu.config import FedConfig, resolve_server_pipeline
+
+    fed = FedConfig()
+    assert fed.server_pipeline == "auto"
+    assert resolve_server_pipeline(fed) == "barrier"
+    assert (
+        resolve_server_pipeline(FedConfig(delta_layout="flat")) == "stream"
+    )

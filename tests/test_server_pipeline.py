@@ -1,0 +1,264 @@
+"""Streaming server aggregation (``FedConfig.server_pipeline="stream"``).
+
+The stream pipeline decodes each StartTrain reply into its row of one flat
+``[clients, P]`` buffer and ships it to the device as it arrives; the only
+post-barrier work is a single fused mean/unpack/server-opt finalize. These
+tests pin the tentpole invariants over REAL gRPC on localhost:
+
+- stream == barrier BIT-PARITY for the mean aggregator, across delta
+  layouts (flat + per_leaf) and compressions (none / int8 / topk). The
+  tests run on the 8-virtual-device CPU platform (tests/conftest.py), so
+  the server-side jits execute on a multi-device backend — the "mesh
+  present" case; the gRPC server itself is single-program by construction.
+- a failed client's row never enters the aggregate (the gather path that
+  keeps parity when the buffer holds rows the barrier path would not
+  stack);
+- config validation rejects stream + robust aggregation / DP with a
+  reason string, and "auto" streams exactly for the flat layout;
+- the round record carries the collect/decode/H2D/aggregate phase timing.
+"""
+
+import dataclasses
+import socket
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+import jax
+
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RoundConfig,
+    resolve_server_pipeline,
+)
+from fedtpu.transport.federation import PrimaryServer, serve_client
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def pipeline_cfg(
+    layout="flat", compression="none", pipeline="auto", num_clients=2,
+    **fed_kwargs,
+) -> RoundConfig:
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(
+            num_clients=num_clients,
+            num_rounds=2,
+            compression=compression,
+            topk_fraction=0.25,
+            delta_layout=layout,
+            server_pipeline=pipeline,
+            **fed_kwargs,
+        ),
+        steps_per_round=2,
+    )
+
+
+def run_federation(cfg, rounds=3, dead_tail=0):
+    """Fresh clients + a fresh primary, ``rounds`` rounds; returns
+    (flat params vector, round records, primary). ``dead_tail`` appends
+    that many never-listening client addresses to the registry."""
+    addrs, servers = [], []
+    try:
+        for i in range(cfg.fed.num_clients - dead_tail):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+        for _ in range(dead_tail):
+            addrs.append(f"localhost:{free_port()}")  # nothing listening
+        primary = PrimaryServer(cfg, addrs)
+        if cfg.fed.compression != "none":
+            primary.sync_clients()  # run() does this; round() alone needs it
+        recs = [primary.round() for _ in range(rounds)]
+        flat = np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree.leaves(primary.params)]
+        )
+        return flat, recs, primary
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+# ----------------------------------------------------------- bit parity
+@pytest.mark.parametrize("layout", ["flat", "per_leaf"])
+@pytest.mark.parametrize("compression", ["none", "int8", "topk"])
+def test_stream_barrier_bit_parity(layout, compression):
+    """Identical client trajectories -> the streamed aggregate must be
+    BIT-IDENTICAL to the barrier path's, for every layout x compression.
+    This holds because the stream finalize runs the same order-stable
+    stacked axis-0 reduce as the barrier mean over the same rows (a running
+    per-arrival fold would NOT be bit-stable — fedtpu.core.round.
+    flat_weighted_mean's docstring records the measurement)."""
+    a, recs_a, pa = run_federation(
+        pipeline_cfg(layout, compression, "stream")
+    )
+    b, recs_b, pb = run_federation(
+        pipeline_cfg(layout, compression, "barrier")
+    )
+    assert pa.server_pipeline == "stream"
+    assert pb.server_pipeline == "barrier"
+    assert recs_a[-1]["participants"] == 2
+    np.testing.assert_array_equal(a, b)
+
+
+def test_stream_parity_with_round_deadline():
+    """The deadline knob composes with streaming: with no straggler the
+    deadline path must aggregate the same rows -> bitwise-equal params."""
+    a, _, _ = run_federation(pipeline_cfg(pipeline="stream"), rounds=2)
+    cfg = pipeline_cfg(pipeline="stream")
+    addrs, servers = [], []
+    try:
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+        primary = PrimaryServer(cfg, addrs, round_deadline_s=120.0)
+        for _ in range(2):
+            primary.round()
+        b = np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree.leaves(primary.params)]
+        )
+    finally:
+        for s in servers:
+            s.stop(0)
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------- failure mid-stream
+def test_failed_client_row_never_enters_accumulator():
+    """A client that RpcErrors never contributes a row: its (zero) buffer
+    row is gathered OUT before the reduce, so the streamed aggregate is
+    bit-identical to the barrier aggregate over the same survivors."""
+    a, recs_a, pa = run_federation(
+        pipeline_cfg(pipeline="stream", num_clients=3), dead_tail=1
+    )
+    b, recs_b, _ = run_federation(
+        pipeline_cfg(pipeline="barrier", num_clients=3), dead_tail=1
+    )
+    assert recs_a[0]["participants"] == 2
+    assert recs_a[0]["alive"] == [True, True, False]
+    assert recs_b[0]["participants"] == 2
+    np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------- config validation
+def test_stream_rejects_robust_aggregators_with_reason():
+    for agg in ("median", "trimmed_mean", "krum"):
+        fed = FedConfig(
+            aggregator=agg, server_pipeline="stream", compression="none"
+        )
+        with pytest.raises(ValueError, match="per-coordinate sums"):
+            resolve_server_pipeline(fed)
+        # PrimaryServer construction enforces it too.
+        cfg = pipeline_cfg(pipeline="stream", aggregator=agg, weighted=False)
+        with pytest.raises(ValueError, match="server_pipeline='stream'"):
+            PrimaryServer(cfg, [])
+
+
+def test_stream_rejects_dp_with_reason():
+    fed = FedConfig(
+        server_pipeline="stream", dp_clip_norm=1.0, weighted=False
+    )
+    with pytest.raises(ValueError, match="DP"):
+        resolve_server_pipeline(fed)
+
+
+def test_auto_streams_for_flat_layout_only():
+    assert resolve_server_pipeline(FedConfig(delta_layout="flat")) == "stream"
+    assert (
+        resolve_server_pipeline(FedConfig(delta_layout="per_leaf"))
+        == "barrier"
+    )
+    # Auto silently falls back to barrier for non-streamable combines —
+    # only an EXPLICIT stream request errors.
+    assert (
+        resolve_server_pipeline(
+            FedConfig(delta_layout="flat", aggregator="median",
+                      compression="none")
+        )
+        == "barrier"
+    )
+    assert (
+        resolve_server_pipeline(
+            FedConfig(delta_layout="flat", dp_clip_norm=1.0, weighted=False)
+        )
+        == "barrier"
+    )
+    with pytest.raises(ValueError, match="unknown server_pipeline"):
+        resolve_server_pipeline(FedConfig(server_pipeline="eager"))
+
+
+# --------------------------------------------------------- phase timing
+@pytest.mark.parametrize("pipeline", ["stream", "barrier"])
+def test_round_record_carries_phase_timing(pipeline):
+    _, recs, primary = run_federation(
+        pipeline_cfg(pipeline=pipeline), rounds=1
+    )
+    rec = recs[0]
+    assert rec["pipeline"] == pipeline
+    for key in (
+        "t_collect_s", "t_decode_s", "t_h2d_s", "t_aggregate_s",
+        "t_post_barrier_s",
+    ):
+        assert key in rec and rec[key] >= 0.0, (key, rec)
+    # Decode work happened and the collect phase wall-clock is sane.
+    assert rec["t_decode_s"] > 0.0
+    assert rec["t_collect_s"] > 0.0
+    if pipeline == "stream":
+        assert rec["t_h2d_s"] > 0.0  # rows were shipped during collect
+    else:
+        assert rec["t_h2d_s"] == 0.0  # transfer rides the aggregate dispatch
+
+
+def test_stream_replies_decode_without_template_trees():
+    """The stream path must not build per-leaf delta templates: the
+    per-round template cache stays empty for sparse replies (flat layout),
+    which is the decode-into-row claim in one observable bit."""
+    cfg = pipeline_cfg(layout="flat", compression="int8", pipeline="stream")
+    addrs, servers = [], []
+    try:
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+        primary = PrimaryServer(cfg, addrs)
+        primary.sync_clients()
+        import fedtpu.transport.federation as fed_mod
+
+        calls = []
+        real = fed_mod.sparse.decode
+
+        def spy(data, like):
+            calls.append(1)
+            return real(data, like)
+
+        fed_mod.sparse.decode = spy
+        try:
+            rec = primary.round()
+        finally:
+            fed_mod.sparse.decode = real
+        assert rec["participants"] == 2
+        assert not calls, "stream path fell back to template tree decode"
+    finally:
+        for s in servers:
+            s.stop(0)

@@ -255,3 +255,92 @@ def test_flat_decode_rejects_bad_indices_and_sizes(rng):
     payload = sparse._frame(serialization.msgpack_serialize(body))
     with pytest.raises(WireError):
         sparse.decode(payload, tmpl)
+
+
+# ------------------------------------------------- decode-into-row (stream)
+def _layout_sizes(tree):
+    import jax
+
+    return [int(np.size(l)) for l in jax.tree.leaves(tree)]
+
+
+def _row_from_tree(tree):
+    import jax
+
+    return np.concatenate(
+        [np.asarray(l, np.float32).ravel() for l in jax.tree.leaves(tree)]
+    )
+
+
+@pytest.mark.parametrize("encoder,kwargs", [
+    (sparse.encode_topk, {"fraction": 0.1}),
+    (sparse.encode_int8, {}),
+    (sparse.encode_topk_flat, {"fraction": 0.1}),
+    (sparse.encode_int8_flat, {}),
+])
+def test_decode_into_row_matches_tree_decode(rng, encoder, kwargs):
+    """The streaming server's row-target decode reconstructs EXACTLY what
+    the template decode reconstructs, for all four record kinds — just
+    straight into the flat row, with no per-leaf pytrees."""
+    tree = delta_tree(rng)
+    payload, _ = encoder(
+        tree, extra={"num_examples": np.float32(5)}, **kwargs
+    )
+    via_tree, extra_t = sparse.decode(payload, zeros_like_tree(tree))
+    sizes = _layout_sizes(tree)
+    total = sum(sizes)
+    out = np.zeros((total + 128,), np.float32)  # padded row: pad stays 0
+    extra_r = sparse.decode_into_row(payload, sizes, out)
+    assert float(extra_r["num_examples"]) == 5
+    assert float(extra_t["num_examples"]) == 5
+    np.testing.assert_array_equal(out[:total], _row_from_tree(via_tree))
+    np.testing.assert_array_equal(out[total:], 0.0)
+
+
+def test_decode_into_row_rejects_mismatch_and_bad_indices(rng):
+    tree = delta_tree(rng)
+    sizes = _layout_sizes(tree)
+    out = np.zeros((sum(sizes),), np.float32)
+    payload, _ = sparse.encode_topk_flat(tree, 0.1)
+    # Layout with a different leaf count / sizes -> WireError, like decode.
+    with pytest.raises(WireError):
+        sparse.decode_into_row(payload, sizes[:-1], out)
+    roomy = np.zeros((sum(sizes) + 64,), np.float32)
+    with pytest.raises(WireError):
+        sparse.decode_into_row(payload, [s + 1 for s in sizes], roomy)
+    # Out-of-range index in a hand-built record: heap-write guard.
+    from flax import serialization
+
+    body = {
+        "kind": "topk_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "idx": np.array([sum(sizes)], np.int32),
+        "vals": np.array([1.0], np.float32),
+        "extra": {},
+    }
+    bad = sparse._frame(serialization.msgpack_serialize(body))
+    with pytest.raises(WireError):
+        sparse.decode_into_row(bad, sizes, out)
+    # A too-small target row is a caller bug, raised loudly.
+    with pytest.raises(ValueError):
+        sparse.decode_into_row(payload, sizes, out[: sum(sizes) - 1])
+
+
+def test_dense_wire_decode_into_row(rng):
+    """wire.decode_into_row: dense full-weight payload -> delta-vs-base
+    written straight into the row (the stream pipeline's unsynced-client /
+    compression='none' fallback)."""
+    from fedtpu.transport import wire
+
+    model = delta_tree(rng)  # stands in for {"params","batch_stats"} weights
+    base = delta_tree(rng)
+    payload_tree = dict(model, num_examples=np.float32(11))
+    data = wire.encode(payload_tree)
+    like = zeros_like_tree(payload_tree)
+    sizes = _layout_sizes(model)
+    out = np.zeros((sum(sizes) + 64,), np.float32)
+    extra = wire.decode_into_row(data, like, base, out)
+    assert float(extra["num_examples"]) == 11
+    expect = _row_from_tree(model) - _row_from_tree(base)
+    np.testing.assert_array_equal(out[: sum(sizes)], expect)
+    np.testing.assert_array_equal(out[sum(sizes):], 0.0)

@@ -152,3 +152,22 @@ def test_script_and_bench_jobs_expose_guards_and_env(watch):
             assert os.path.exists(path), (
                 f"queued job {name} points at a missing script: {path}"
             )
+
+
+def test_queue_is_driver_bench_first_with_hard_budgets(watch):
+    """Round-6 queue shape (VERDICT r5 "Next round" #1): the driver-path
+    headline bench is job #1 with a ~5-minute hard budget, and EVERY job
+    carries a finite per-job wall-clock budget so one hung job can never
+    eat a whole window. Any window >= 5 min therefore yields at least the
+    BENCH_LIVE_r06 headline capture."""
+    names = [name for name, _ in watch.JOBS]
+    assert names[0] == "bench_fused_r06"
+    for name, job in watch.JOBS:
+        budget = getattr(job, "budget_s", None)
+        assert budget is not None and budget > 0, (
+            f"job {name} has no hard wall-clock budget"
+        )
+    # The headline job's budget is the ~5-minute window bound.
+    assert watch.JOBS[0][1].budget_s <= 360
+    # The expensive acc-full parity run fires only after the quick wins.
+    assert names[-1] == "acc_full_fedtpu"

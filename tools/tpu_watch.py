@@ -91,15 +91,19 @@ def probe(timeout_s):
     return True, proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "ok"
 
 
-def _bench_job(artifact, env=None):
+def _bench_job(artifact, env=None, budget_s=300):
     """Run bench.py; success = a JSON line with value > 0, saved as the live
     artifact (bench.py itself is already subprocess-isolated + bounded).
     ``env`` selects a variant leg (FEDTPU_BENCH_MODEL / FEDTPU_MOMENTUM_DTYPE
-    — see bench.py); the default is the driver's exact parity run."""
+    — see bench.py); the default is the driver's exact parity run.
+    ``budget_s`` is the job's HARD wall-clock budget: a healthy window
+    completes the measurement in ~2-4 min (persistent compile cache), so a
+    job past its budget means the tunnel re-wedged — kill it and keep the
+    window for the rest of the queue (VERDICT r5 "Next round" #1)."""
     def run():
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True, text=True, timeout=3600,
+            capture_output=True, text=True, timeout=budget_s,
             env=dict(os.environ, **(env or {})),
         )
         from jsontail import last_json_line
@@ -117,15 +121,17 @@ def _bench_job(artifact, env=None):
             line["captured_env"] = dict(env)
         atomic_write(os.path.join(ART, artifact), json.dumps(line, indent=2))
         return True, f"value={line['value']} {line.get('unit', '')} mfu={line.get('mfu')}"
+    run.budget_s = budget_s
     return run
 
 
-def _script_job(rel, timeout_s, artifact, env=None):
+def _script_job(rel, budget_s, artifact, env=None):
+    """``budget_s`` is the job's hard wall-clock budget (see _bench_job)."""
     def run():
         run_env = dict(os.environ, **(env or {}))
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, rel)],
-            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+            capture_output=True, text=True, timeout=budget_s, cwd=REPO,
             env=run_env,
         )
         ok = proc.returncode == 0 and os.path.exists(os.path.join(ART, artifact))
@@ -135,46 +141,50 @@ def _script_job(rel, timeout_s, artifact, env=None):
     # script hasn't landed yet — a missing script would otherwise trip
     # stop-on-first-failure and starve the rest of the queue for the window.
     run.script_path = os.path.join(REPO, rel)
+    run.budget_s = budget_s
     return run
 
 
 JOBS = [
-    # Round-5 queue (2026-07-31), in VERDICT r4 priority order.
-    # 1-2: the two round-4 artifacts the wedge stranded (VERDICT "missing" #1).
-    ("acc_full_fedtpu",
-     _script_job("tools/run_accfull_tpu.py", 3100, "PARITY_ACC_FULL.jsonl")),
-    ("resnet18_bench",
-     _script_job("tools/bench_resnet_tpu.py", 2800, "BENCH_RESNET_TPU.json")),
-    # 3: the driver's exact bench path, captured live (VERDICT #2).
-    ("bench_fused_r05", _bench_job("BENCH_LIVE_r05.json")),
-    # 4: the reference's DEFAULT model (src/main.py:69) on chip (VERDICT #3).
+    # Round-6 queue (2026-08-04), restructured for guaranteed capture
+    # (VERDICT r5 "Next round" #1): the driver-path headline bench is job
+    # #1 with a hard ~5-minute budget, so ANY window >= 5 min yields the
+    # BENCH_LIVE_r06 capture instead of wedging mid-acc_full like round 5's
+    # 04:12 probe. Every job carries a hard per-job wall-clock budget — one
+    # hung job can no longer eat a whole window; the expensive acc-full
+    # parity run goes LAST, after every quick win is banked.
+    # 1: the driver's exact bench path, captured live.
+    ("bench_fused_r06", _bench_job("BENCH_LIVE_r06.json", budget_s=300)),
+    # 2-3: the two on-chip model headline rows (VERDICT r5 #2) — each a
+    # single fused measurement, budgeted like the headline.
     ("mobilenet_bench",
-     _script_job("tools/bench_model_tpu.py", 2800, "BENCH_MOBILENET_TPU.json")),
-    # 5-6: the two roofline experiments (VERDICT #4) — optimizer-state
+     _script_job("tools/bench_model_tpu.py", 300, "BENCH_MOBILENET_TPU.json")),
+    ("resnet18_bench",
+     _script_job("tools/bench_resnet_tpu.py", 420, "BENCH_RESNET_TPU.json")),
+    # 4-5: the two roofline experiments (VERDICT r5 #4) — optimizer-state
     # traffic (bf16 momentum) and pool cost (avg-pool ablation), each an
     # end-to-end bench so they're kept/rejected on data like the round-4
     # negatives.
     ("bench_mom_bf16",
-     _bench_job("BENCH_LIVE_r05_mombf16.json",
+     _bench_job("BENCH_LIVE_r06_mombf16.json", budget_s=300,
                 env={"FEDTPU_MOMENTUM_DTYPE": "bfloat16"})),
     ("bench_avgpool",
-     _bench_job("BENCH_LIVE_r05_avgpool.json",
+     _bench_job("BENCH_LIVE_r06_avgpool.json", budget_s=300,
                 env={"FEDTPU_BENCH_MODEL": "smallcnn_avgpool"})),
-    # 7: a fresh profile at whatever the round's best config turns out to be.
-    ("mfu_profile_r05",
-     _script_job("tools/bench_profile_tpu.py", 2400, "MFU_PROFILE_r05.json",
-                 env={"FEDTPU_PROFILE_TAG": "r05"})),
-    # 8-9: cheap follow-ons if the window holds — deeper fusion (40 rounds
-    # per dispatch amortises the ~70 ms tunnel dispatch floor further) and
-    # the full experiment stack combined.
+    # 6: a fresh profile at whatever the round's best config turns out to be.
+    ("mfu_profile_r06",
+     _script_job("tools/bench_profile_tpu.py", 420, "MFU_PROFILE_r06.json",
+                 env={"FEDTPU_PROFILE_TAG": "r06"})),
+    # 7: cheap follow-on — deeper fusion (40 rounds per dispatch amortises
+    # the ~70 ms tunnel dispatch floor further).
     ("bench_fused40",
-     _bench_job("BENCH_LIVE_r05_fused40.json",
+     _bench_job("BENCH_LIVE_r06_fused40.json", budget_s=300,
                 env={"FEDTPU_BENCH_TIMED_ROUNDS": "40"})),
-    ("bench_stack",
-     _bench_job("BENCH_LIVE_r05_stack.json",
-                env={"FEDTPU_MOMENTUM_DTYPE": "bfloat16",
-                     "FEDTPU_BENCH_MODEL": "smallcnn_avgpool",
-                     "FEDTPU_BENCH_TIMED_ROUNDS": "40"})),
+    # 8: the long acc-full parity run, LAST — it only fires in a window
+    # that has already banked everything above, and its budget caps the
+    # worst case at ~25 min instead of wedging the whole window.
+    ("acc_full_fedtpu",
+     _script_job("tools/run_accfull_tpu.py", 1500, "PARITY_ACC_FULL.jsonl")),
 ]
 
 

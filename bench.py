@@ -531,6 +531,260 @@ def _server_pipeline_microbench():
     return result
 
 
+def _telemetry_microbench():
+    """``telemetry_overhead``: what FedConfig.telemetry costs per round.
+
+    Two measurements, reported side by side because only one of them can
+    actually resolve the effect:
+
+    - **Attributable cost** (the headline ``value``): the engine's basic
+      mode adds EXACTLY one no-op span call and one registry counter
+      increment per round; trace mode swaps in a real span. That exact
+      per-round instrument sequence is timed directly (tight loop,
+      20k iterations) and divided by the off-mode round wall. This is the
+      physical overhead, and it is sub-ppm on seconds-scale rounds.
+    - **A/B wall times**: the SAME engine instance (one compile, one
+      jitted program — the jits never close over the telemetry object,
+      which is exactly why it is swappable) drives full FedAvg rounds on
+      densenet_cifar (CPU) under off / basic / trace, with the mode order
+      rotated every rep so machine drift cannot masquerade as overhead;
+      medians reported as ``round_ms`` / ``ab_delta_pct`` next to
+      ``noise_floor_pct`` (the off-mode trials' own spread). Differencing
+      ~seconds walls with ~1% run-to-run jitter cannot resolve a ~1 us
+      effect — two fixed-order runs measured 1.3-1.5% "overhead" that
+      rotation reassigned to noise (trace cheaper than basic, which is a
+      strict superset) — so the A/B block is the audit trail showing the
+      delta sits inside the noise floor, not the estimator.
+
+    A second leg runs a real 2-client/2-round gRPC federation at
+    ``telemetry=trace`` with the streaming server pipeline and validates
+    the exported Chrome trace: decode/h2d/aggregate spans must carry
+    non-negative durations, resolve to a ``round`` root via their
+    parent_id chain, and sit inside that round span's [ts, ts+dur] window
+    — i.e. the Perfetto view nests the phases under their round. The
+    trace itself lands at artifacts/TELEMETRY_TRACE.json.
+
+    Run via ``python bench.py --telemetry-microbench``; prints one JSON
+    line and writes ``artifacts/TELEMETRY_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.obs import Telemetry
+
+    model_name = os.environ.get("FEDTPU_TB_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_TB_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_TB_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_TB_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_TB_BATCH", "8"))
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=10,
+        data=DataConfig(
+            dataset="cifar10", batch_size=batch, partition="iid",
+            num_examples=clients * batch * 4,
+        ),
+        fed=FedConfig(num_clients=clients, telemetry="off"),
+        steps_per_round=1,
+    )
+    fed = Federation(cfg, seed=0)
+
+    def run_block():
+        for _ in range(rounds):
+            m = fed.step()
+        # Fetching a program output is the honest sync point (OPERATIONS
+        # rule 4); identical in every mode, so it cancels in the deltas.
+        np.asarray(m.loss)
+
+    run_block()  # compile + warmup
+    modes = ("off", "basic", "trace")
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        # Rotate the mode order each rep: with a FIXED order, any slow
+        # machine-wide drift within a rep lands on the same modes every
+        # time and reads as fake overhead (measured: ~1.5% phantom basic
+        # overhead from ordering alone on 5.8 s densenet rounds, against a
+        # ~1 us true per-round cost). Rotation cancels the positional bias.
+        for mode in modes[rep % 3:] + modes[: rep % 3]:
+            fed.telemetry = Telemetry(mode)
+            t0 = time.perf_counter()
+            run_block()
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = {
+        mode: (med[mode] - med["off"]) / med["off"] * 100.0
+        for mode in ("basic", "trace")
+    }
+    noise_floor_pct = (
+        (max(trials["off"]) - min(trials["off"])) / med["off"] * 100.0
+    )
+
+    # Attributable cost: time the EXACT per-round instrument sequence the
+    # engine adds in each mode (see Federation.step), then scale by the
+    # off-mode round wall. This resolves what the A/B differencing cannot.
+    n = 20000
+
+    def timed_ops(tel):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tel.span("round", round=0):
+                pass
+            tel.counter("fedtpu_rounds_completed_total", "rounds").inc()
+        return (time.perf_counter() - t0) / n * 1e6  # us per round
+
+    per_round_us = {
+        "basic": timed_ops(Telemetry("basic")),
+        "trace": timed_ops(Telemetry("trace")),
+    }
+    attributable_pct = {
+        mode: us / (med["off"] * 1e6) * 100.0
+        for mode, us in per_round_us.items()
+    }
+
+    # Raw instrument costs, for the arithmetic's audit trail.
+    tel = Telemetry("trace")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tel.span("x"):
+            pass
+    span_ns = (time.perf_counter() - t0) / n * 1e9
+    c = tel.counter("c")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.inc()
+    counter_ns = (time.perf_counter() - t0) / n * 1e9
+    h = tel.histogram("h")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        h.observe(0.01)
+    hist_ns = (time.perf_counter() - t0) / n * 1e9
+
+    trace_check = _telemetry_trace_leg()
+
+    result = {
+        "metric": "telemetry_overhead",
+        "unit": "% of round wall time attributable to telemetry=basic "
+                "instruments",
+        # Headline: the per-round basic-mode instrument cost over the
+        # off-mode round wall — the resolvable, physical overhead. The A/B
+        # medians + noise floor below show the wall-clock deltas sit
+        # inside run-to-run jitter (see docstring).
+        "value": round(attributable_pct["basic"], 6),
+        "attributable_pct": {
+            k: round(v, 6) for k, v in attributable_pct.items()
+        },
+        "per_round_instrument_us": {
+            k: round(v, 3) for k, v in per_round_us.items()
+        },
+        "ab_delta_pct": {k: round(v, 3) for k, v in ab_delta_pct.items()},
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "instrument_ns": {
+            "span_trace_mode": round(span_ns, 1),
+            "counter_inc": round(counter_ns, 1),
+            "histogram_observe": round(hist_ns, 1),
+        },
+        "trace_check": trace_check,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "TELEMETRY_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
+def _telemetry_trace_leg():
+    """The microbench's trace-validation leg (see _telemetry_microbench)."""
+    import socket
+
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+    from fedtpu.obs import write_chrome_trace
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(
+            num_clients=2, num_rounds=2, telemetry="trace",
+            server_pipeline="stream",
+        ),
+        steps_per_round=2,
+    )
+    servers = []
+    try:
+        addrs = []
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+        primary = PrimaryServer(cfg, addrs)
+        for _ in range(2):
+            primary.round()
+        events = primary.telemetry.trace_events()
+        os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+        trace_path = os.path.join(ARTIFACTS_DIR, "TELEMETRY_TRACE.json")
+        write_chrome_trace(events, trace_path)
+
+        by_id = {e["args"]["span_id"]: e for e in events}
+
+        def root(e):
+            while "parent_id" in e["args"]:
+                e = by_id[e["args"]["parent_id"]]
+            return e
+
+        nested = True
+        phase_counts = {}
+        for name in ("decode", "h2d", "aggregate"):
+            phase_events = [e for e in events if e["name"] == name]
+            phase_counts[name] = len(phase_events)
+            for e in phase_events:
+                r = root(e)
+                inside = (
+                    r["name"] == "round"
+                    and r["ts"] - 1e-3 <= e["ts"]
+                    and e["ts"] + e["dur"] <= r["ts"] + r["dur"] + 1e-3
+                )
+                nested = nested and inside
+        return {
+            "trace_path": "artifacts/TELEMETRY_TRACE.json",
+            "num_events": len(events),
+            "rounds": sum(1 for e in events if e["name"] == "round"),
+            "phase_span_counts": phase_counts,
+            "nonnegative_durations": all(e["dur"] >= 0 for e in events),
+            "phases_nest_under_round": nested
+            and all(phase_counts[n] > 0 for n in phase_counts),
+        }
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
@@ -637,6 +891,9 @@ def main():
         return
     if "--server-pipeline-microbench" in sys.argv:
         print(json.dumps(_server_pipeline_microbench()))
+        return
+    if "--telemetry-microbench" in sys.argv:
+        print(json.dumps(_telemetry_microbench()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

@@ -199,12 +199,64 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         "sampling proportional to each client's last training loss",
     )
     p.add_argument(
+        "--telemetry",
+        default="basic",
+        choices=["off", "basic", "trace"],
+        help="self-measurement level (fedtpu.obs; docs/OBSERVABILITY.md): "
+        "off = nothing; basic (default) = thread-safe metrics registry "
+        "(RPC bytes, compression ratio, phase times, FT transitions; "
+        "dump with --prom-out), <1%% round overhead; trace = basic plus "
+        "nested round/client/phase spans exported as Perfetto-loadable "
+        "Chrome trace JSON (--trace-out) and bridged to "
+        "jax.profiler.TraceAnnotation under --profile-dir",
+    )
+    p.add_argument(
         "--debug-per-batch",
         action="store_true",
         help="print per-batch loss/acc from inside the jitted local epoch "
         "(the reference's mid-epoch console lines, src/utils.py:51-92). "
         "Host callback per batch — debugging only, ruins throughput",
     )
+
+
+def add_telemetry_export_flags(p: argparse.ArgumentParser) -> None:
+    """End-of-run exporter paths, shared by the run and server CLIs (the
+    per-round JSONL exporter is the existing ``--metrics`` flag)."""
+    p.add_argument(
+        "--prom-out",
+        default=None,
+        metavar="PATH",
+        help="write the cumulative metrics registry as a Prometheus "
+        "text-format dump at exit (the file-shaped /metrics endpoint; "
+        "requires --telemetry basic or trace)",
+    )
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="PATH",
+        help="write the collected spans as Chrome trace-event JSON at exit "
+        "(load in Perfetto / chrome://tracing; requires --telemetry trace)",
+    )
+
+
+def export_telemetry(args, telemetry) -> None:
+    """Honor --prom-out/--trace-out against a component's Telemetry."""
+    import logging
+
+    if getattr(args, "prom_out", None):
+        if telemetry.enabled:
+            telemetry.export_prometheus(args.prom_out)
+        else:
+            logging.warning(
+                "--prom-out ignored: --telemetry off collects no metrics"
+            )
+    if getattr(args, "trace_out", None):
+        if telemetry.tracing:
+            telemetry.export_trace(args.trace_out)
+        else:
+            logging.warning(
+                "--trace-out ignored: spans need --telemetry trace"
+            )
 
 
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
@@ -257,6 +309,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             participation_sampling=getattr(
                 args, "participation_sampling", "uniform"
             ),
+            telemetry=getattr(args, "telemetry", "basic"),
         ),
         steps_per_round=steps_per_round,
         debug_per_batch=getattr(args, "debug_per_batch", False),

@@ -15,10 +15,18 @@ import time
 import numpy as np
 
 from fedtpu.checkpoint import Checkpointer
-from fedtpu.cli.common import add_fed_flags, add_model_flags, add_platform_flag, apply_platform_flag, build_config
+from fedtpu.cli.common import (
+    add_fed_flags,
+    add_model_flags,
+    add_platform_flag,
+    add_telemetry_export_flags,
+    apply_platform_flag,
+    build_config,
+    export_telemetry,
+)
 from fedtpu.core import Federation
 from fedtpu.data import load
-from fedtpu.utils.metrics import MetricsLogger
+from fedtpu.obs import RoundRecordWriter
 
 
 def main(argv=None) -> int:
@@ -73,7 +81,13 @@ def main(argv=None) -> int:
         "sigma; 0 = uniform). Larger -> slow clients accumulate staleness",
     )
     p.add_argument("--eval-every", default=5, type=int)
-    p.add_argument("--metrics", default=None, help="JSONL metrics path")
+    p.add_argument(
+        "--metrics", default=None,
+        help="JSONL metrics path: one schema-versioned round record per "
+        "round (fedtpu.obs.RoundRecordWriter; summarize with "
+        "tools/metrics_report.py)",
+    )
+    add_telemetry_export_flags(p)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
     p.add_argument("-r", "--resume", action="store_true")
@@ -103,7 +117,7 @@ def main(argv=None) -> int:
         fed.state = jax.tree.map(jnp.asarray, state)
         logging.info("resumed from round %d", start_round)
 
-    logger = MetricsLogger(path=args.metrics, echo=not args.progress)
+    logger = RoundRecordWriter(path=args.metrics, echo=not args.progress)
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
     )
@@ -176,6 +190,7 @@ def main(argv=None) -> int:
     logging.info(
         "%d rounds in %.1fs (%.2f rounds/s)", done, dt, done / max(dt, 1e-9)
     )
+    export_telemetry(args, fed.telemetry)
     return 0
 
 
@@ -231,7 +246,7 @@ def _run_async(args, cfg) -> int:
     if state is not None:
         fed.load_state(state)  # async re-placement (mesh-aware)
         logging.info("resumed async state from update %d", start_tick)
-    logger = MetricsLogger(path=args.metrics, echo=True)
+    logger = RoundRecordWriter(path=args.metrics, echo=True)
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
     )
@@ -246,6 +261,7 @@ def _run_async(args, cfg) -> int:
         "%d async updates in %.1fs (%.2f updates/s)",
         done, dt, done / max(dt, 1e-9),
     )
+    export_telemetry(args, fed.telemetry)
     return 0
 
 

@@ -17,7 +17,16 @@ import jax
 import jax.numpy as jnp
 
 from fedtpu.checkpoint import Checkpointer
-from fedtpu.cli.common import add_fed_flags, add_model_flags, add_platform_flag, apply_platform_flag, build_config, compress_enabled
+from fedtpu.cli.common import (
+    add_fed_flags,
+    add_model_flags,
+    add_platform_flag,
+    add_telemetry_export_flags,
+    apply_platform_flag,
+    build_config,
+    compress_enabled,
+    export_telemetry,
+)
 from fedtpu.transport.federation import BackupServer, PrimaryServer, _model_template
 
 
@@ -39,11 +48,13 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument(
         "--metrics", default=None,
-        help="JSONL metrics path: one structured record per round "
-        "(participants, wire bytes, and the collect/decode/H2D/aggregate "
-        "phase timing the streaming pipeline reports — see "
-        "--server-pipeline)",
+        help="JSONL metrics path: one schema-versioned round record "
+        "(fedtpu.obs.RoundRecordWriter) per round — participants, wire "
+        "bytes, and the collect/decode/H2D/aggregate phase timing the "
+        "streaming pipeline reports (see --server-pipeline; summarize "
+        "with tools/metrics_report.py)",
     )
+    add_telemetry_export_flags(p)
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
@@ -128,9 +139,9 @@ def main(argv=None) -> int:
                     primary.install_state(tree)
                     start_round = r + 1
                     logging.info("resumed global model from round %d", r)
-        from fedtpu.utils.metrics import MetricsLogger
+        from fedtpu.obs import RoundRecordWriter
 
-        metrics = MetricsLogger(path=args.metrics) if args.metrics else None
+        metrics = RoundRecordWriter(path=args.metrics) if args.metrics else None
 
         def on_round(r: int, rec: dict) -> None:
             if metrics is not None:
@@ -157,6 +168,7 @@ def main(argv=None) -> int:
         finally:
             if metrics is not None:
                 metrics.close()
+            export_telemetry(args, primary.telemetry)
         return 0
 
     backup = BackupServer(

@@ -15,7 +15,7 @@ import logging
 
 from fedtpu.cli.common import add_model_flags, add_platform_flag, apply_platform_flag, build_config
 from fedtpu.core.solo import run_solo
-from fedtpu.utils.metrics import MetricsLogger
+from fedtpu.obs import RoundRecordWriter
 
 
 def main(argv=None) -> int:
@@ -59,7 +59,7 @@ def main(argv=None) -> int:
         seed=args.seed,
         checkpoint_path=args.checkpoint,
         resume=args.resume,
-        logger=MetricsLogger(path=args.metrics),
+        logger=RoundRecordWriter(path=args.metrics),
         mesh=mesh,
     )
     logging.info("best test accuracy: %.4f", trainer.best_acc)

@@ -176,6 +176,20 @@ class FedConfig:
     # Engine-side (simulated) federation ignores this knob: there is no
     # network edge to overlap.
     server_pipeline: str = "auto"  # auto | barrier | stream
+    # How much the framework measures itself (fedtpu.obs; see
+    # docs/OBSERVABILITY.md):
+    #   "off":   no registry metrics, no spans. Round records keep their
+    #     wire/phase fields (that accounting is part of the round API).
+    #   "basic" (default): thread-safe counters/gauges/histograms (RPC
+    #     bytes, compression ratio, phase times, retries, heartbeat misses,
+    #     failover transitions, rounds completed), exportable as Prometheus
+    #     text. Overhead <1% of round wall time (bench.py
+    #     --telemetry-microbench, artifacts/TELEMETRY_MICROBENCH.json).
+    #   "trace": basic plus the span tracer — nested round/client/phase
+    #     spans exported as Chrome trace-event JSON (Perfetto-loadable) and
+    #     bridged to jax.profiler.TraceAnnotation so XLA device activity
+    #     nests under framework spans when a profiler session is active.
+    telemetry: str = "basic"  # off | basic | trace
 
 
 def resolve_server_pipeline(fed: FedConfig) -> str:

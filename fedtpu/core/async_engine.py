@@ -545,6 +545,9 @@ class AsyncFederation:
         # Delegate builds model/data/partitions (mesh-placed when sharded);
         # its sync jits are lazy and never compiled unless used.
         self._fed = Federation(cfg, seed=seed, data=data, mesh=mesh)
+        # Shared telemetry with the delegate: one registry/tracer per
+        # federation instance, whichever loop is driving.
+        self.telemetry = self._fed.telemetry
         self.model = self._fed.model
         sample = jnp.zeros(
             (1,) + tuple(self._fed.images.shape[1:]), jnp.float32
@@ -611,19 +614,26 @@ class AsyncFederation:
     # ---------------------------------------------------------------- ticks
     def tick(self) -> AsyncMetrics:
         """One server update: everyone trains, ``buffer_k`` clients report."""
-        d_images, d_labels, d_idx, d_mask = self._fed._ensure_device_data()
-        self.state, m = self._step(
-            self.state,
-            d_images,
-            d_labels,
-            d_idx,
-            d_mask,
-            self._fed.weights,
-            jnp.asarray(self._arrive_mask()),
-            jnp.asarray(self.alive.copy()),
-            self._fed._data_key,
-        )
+        with self.telemetry.span("async_tick", tick=self._tick_host):
+            d_images, d_labels, d_idx, d_mask = (
+                self._fed._ensure_device_data()
+            )
+            self.state, m = self._step(
+                self.state,
+                d_images,
+                d_labels,
+                d_idx,
+                d_mask,
+                self._fed.weights,
+                jnp.asarray(self._arrive_mask()),
+                jnp.asarray(self.alive.copy()),
+                self._fed._data_key,
+            )
         self._tick_host += 1
+        self.telemetry.counter(
+            "fedtpu_async_updates_total",
+            "simulated FedBuff server updates dispatched",
+        ).inc()
         return m
 
     def run_on_device(self, num_ticks: int) -> AsyncMetrics:
@@ -657,18 +667,25 @@ class AsyncFederation:
                     staleness_damping=self.staleness_damping,
                 )
         d_images, d_labels, d_idx, d_mask = self._fed._ensure_device_data()
-        self.state, m = self._multi_steps[num_ticks](
-            self.state,
-            d_images,
-            d_labels,
-            d_idx,
-            d_mask,
-            self._fed.weights,
-            jnp.asarray(arrive),
-            jnp.asarray(alive),
-            self._fed._data_key,
-        )
+        with self.telemetry.span(
+            "fused_ticks", tick=self._tick_host, num_ticks=num_ticks
+        ):
+            self.state, m = self._multi_steps[num_ticks](
+                self.state,
+                d_images,
+                d_labels,
+                d_idx,
+                d_mask,
+                self._fed.weights,
+                jnp.asarray(arrive),
+                jnp.asarray(alive),
+                self._fed._data_key,
+            )
         self._tick_host += num_ticks
+        self.telemetry.counter(
+            "fedtpu_async_updates_total",
+            "simulated FedBuff server updates dispatched",
+        ).inc(num_ticks)
         return m
 
     # ----------------------------------------------------- checkpoint/resume

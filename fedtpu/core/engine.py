@@ -32,6 +32,7 @@ from fedtpu.core.round import (
 )
 from fedtpu.core.client import make_eval_fn
 from fedtpu.data import data_source, dataset_info, load, partition
+from fedtpu.obs import Telemetry, validate_telemetry_mode
 from fedtpu.utils.metrics import MetricsLogger
 
 # NOTE: fedtpu.data.device imports from fedtpu.core.round, whose package
@@ -90,6 +91,7 @@ class Federation:
                 f"unknown delta_layout {cfg.fed.delta_layout!r}; "
                 "have per_leaf | flat"
             )
+        validate_telemetry_mode(cfg.fed.telemetry)
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -212,6 +214,13 @@ class Federation:
         self._shuffle = shuffle
         self._img_shape = img_shape
         self._multi_steps = {}  # num_rounds -> compiled scan program
+        # Host-side telemetry (fedtpu.obs): spans wrap the per-round
+        # DISPATCH walls (device compute is async; use profile_rounds /
+        # the trace-mode jax bridge for on-device time), counters track
+        # rounds completed. Swappable post-construction — the jitted
+        # programs never close over it (bench.py --telemetry-microbench
+        # retimes one engine under all three modes).
+        self.telemetry = Telemetry(cfg.fed.telemetry)
 
     def _placed(self, x, sharded: bool):
         """Place an array for the active topology: sharded along the clients
@@ -390,6 +399,16 @@ class Federation:
         return self._round_host
 
     def step(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
+        tel = self.telemetry
+        with tel.span("round", round=self._round_number()):
+            metrics = self._step_impl(batch)
+        tel.counter(
+            "fedtpu_rounds_completed_total",
+            "simulated FedAvg rounds dispatched by this engine",
+        ).inc()
+        return metrics
+
+    def _step_impl(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
         r = self._round_number()
         if batch is not None:
             if self.mesh is not None:
@@ -452,29 +471,35 @@ class Federation:
         """
         if num_rounds < 1:
             raise ValueError(f"num_rounds must be >= 1, got {num_rounds}")
+        tel = self.telemetry
         r = self._round_number()
-        alive = np.stack(
-            [self._alive_for_round(r + i) for i in range(num_rounds)]
-        )
-        d_images, d_labels, d_idx, d_mask = self._ensure_device_data()
-        if self.mesh is None:
-            alive_dev = jnp.asarray(alive)
-        else:
-            from fedtpu.parallel.sharded import _put
-            from jax.sharding import PartitionSpec as P
+        with tel.span("fused_rounds", round=r, num_rounds=num_rounds):
+            alive = np.stack(
+                [self._alive_for_round(r + i) for i in range(num_rounds)]
+            )
+            d_images, d_labels, d_idx, d_mask = self._ensure_device_data()
+            if self.mesh is None:
+                alive_dev = jnp.asarray(alive)
+            else:
+                from fedtpu.parallel.sharded import _put
+                from jax.sharding import PartitionSpec as P
 
-            alive_dev = _put(alive, self.mesh, P(None, self.cfg.mesh_axis))
-        self._state, metrics = self._multi_step(num_rounds)(
-            self._state,
-            d_images,
-            d_labels,
-            d_idx,
-            d_mask,
-            self.weights,
-            alive_dev,
-            self._data_key,
-        )
+                alive_dev = _put(alive, self.mesh, P(None, self.cfg.mesh_axis))
+            self._state, metrics = self._multi_step(num_rounds)(
+                self._state,
+                d_images,
+                d_labels,
+                d_idx,
+                d_mask,
+                self.weights,
+                alive_dev,
+                self._data_key,
+            )
         self._round_host = r + num_rounds
+        tel.counter(
+            "fedtpu_rounds_completed_total",
+            "simulated FedAvg rounds dispatched by this engine",
+        ).inc(num_rounds)
         return metrics
 
     def run(
@@ -508,6 +533,10 @@ class Federation:
                 # injected data), immune to later unrelated loads.
                 "data_source": self._data_source,
             }
+            self.telemetry.histogram(
+                "fedtpu_round_wall_seconds",
+                "per-round host wall time (dispatch + sync)",
+            ).observe(rec["round_s"])
             if eval_every and (r + 1) % eval_every == 0 and eval_data is not None:
                 te_loss, te_acc = self.evaluate(*eval_data)
                 rec["test_loss"], rec["test_acc"] = te_loss, te_acc

@@ -20,9 +20,12 @@ bug we do not replicate.)
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 import time
 from typing import Callable, Optional
+
+log = logging.getLogger("fedtpu.ft")
 
 
 class Role(enum.Enum):
@@ -41,6 +44,12 @@ class FailoverStateMachine:
     Transitions:
       - BACKUP --[watchdog expiry]--> ACTING_PRIMARY  (on_promote)
       - ACTING_PRIMARY --[ping with recovering=True]--> BACKUP  (on_demote)
+
+    Every transition is a structured event: ``log.warning`` with the
+    from/to roles plus (when ``metrics`` — a
+    :class:`fedtpu.obs.MetricsRegistry` — is attached) a
+    ``fedtpu_ft_failover_transitions_total{to=...}`` increment. The
+    machine used to change role silently unless the callbacks logged.
     """
 
     def __init__(
@@ -50,11 +59,13 @@ class FailoverStateMachine:
         on_demote: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         arm_without_ping: bool = False,
+        metrics: Optional[object] = None,
     ):
         self.timeout = timeout
         self.on_promote = on_promote
         self.on_demote = on_demote
         self.clock = clock
+        self._metrics = metrics
         self.role = Role.BACKUP
         # The watchdog arms only once a primary has been heard at least once
         # (deliberate divergence: the reference self-promotes ~10 s after
@@ -63,6 +74,15 @@ class FailoverStateMachine:
         # ``arm_without_ping=True`` restores the reference behavior.
         self._last_ping: Optional[float] = clock() if arm_without_ping else None
         self._lock = threading.Lock()
+
+    def _transition_event(self, src: Role, dst: Role, why: str) -> None:
+        log.warning("failover: %s -> %s (%s)", src.value, dst.value, why)
+        if self._metrics is not None:
+            self._metrics.counter(
+                "fedtpu_ft_failover_transitions_total",
+                "role transitions by destination role",
+                labels={"to": dst.value},
+            ).inc()
 
     def on_ping(self, recovering: bool) -> int:
         """Handle one CheckIfPrimaryUp; returns the PingResponse value
@@ -77,6 +97,9 @@ class FailoverStateMachine:
                 self.role = Role.BACKUP
                 demote = True
         if demote:
+            self._transition_event(
+                Role.ACTING_PRIMARY, Role.BACKUP, "primary recovered"
+            )
             if self.on_demote is not None:
                 self.on_demote()
             return 1
@@ -94,8 +117,13 @@ class FailoverStateMachine:
             ):
                 self.role = Role.ACTING_PRIMARY
                 promote = True
-        if promote and self.on_promote is not None:
-            self.on_promote()
+        if promote:
+            self._transition_event(
+                Role.BACKUP, Role.ACTING_PRIMARY,
+                f"no primary ping for > {self.timeout:.1f}s",
+            )
+            if self.on_promote is not None:
+                self.on_promote()
         return promote
 
     def seconds_since_ping(self) -> float:

@@ -14,23 +14,32 @@ clock (the reference's only test was manually killing processes, SURVEY §4).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+log = logging.getLogger("fedtpu.ft")
 
 
 class ClientRegistry:
     """Thread-safe alive/dead registry keyed by client id.
 
     The reference keeps this as a bare dict mutated from three threads with
-    no lock (``src/server.py:31,59-62,95-99``); we lock.
+    no lock (``src/server.py:31,59-62,95-99``); we lock. Alive-state
+    *transitions* (not redundant re-marks) are structured events: logged,
+    and counted into ``metrics`` (a :class:`fedtpu.obs.MetricsRegistry`)
+    when one is attached — previously a client death changed state silently
+    and only surfaced if the caller happened to log around the call.
     """
 
-    def __init__(self, clients: List[str]):
+    def __init__(self, clients: List[str],
+                 metrics: Optional[object] = None):
         self._order = list(clients)
         self._alive: Dict[str, bool] = {c: True for c in clients}
         self._lock = threading.Lock()
+        self._metrics = metrics
 
     @property
     def clients(self) -> List[str]:
@@ -38,11 +47,27 @@ class ClientRegistry:
 
     def mark_failed(self, client: str) -> None:
         with self._lock:
+            was_alive = self._alive[client]
             self._alive[client] = False
+        if was_alive:
+            log.warning("client %s marked dead", client)
+            if self._metrics is not None:
+                self._metrics.counter(
+                    "fedtpu_ft_client_deaths_total",
+                    "alive -> dead client transitions",
+                ).inc()
 
     def mark_alive(self, client: str) -> None:
         with self._lock:
+            was_alive = self._alive[client]
             self._alive[client] = True
+        if not was_alive:
+            log.info("client %s recovered", client)
+            if self._metrics is not None:
+                self._metrics.counter(
+                    "fedtpu_ft_client_recoveries_total",
+                    "dead -> alive client transitions",
+                ).inc()
 
     def is_alive(self, client: str) -> bool:
         with self._lock:
@@ -84,13 +109,19 @@ class HeartbeatMonitor:
         probe: Callable[[str], bool],
         resync: Callable[[str], None],
         period: float = 1.0,
+        metrics: Optional[object] = None,
     ):
         self.registry = registry
         self.probe = probe
         self.resync = resync
         self.period = period
+        self._metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _count(self, name: str, help: str) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(name, help).inc()
 
     def tick(self) -> List[str]:
         """One probe pass; returns the clients recovered this pass.
@@ -106,9 +137,19 @@ class HeartbeatMonitor:
                 try:
                     self.resync(client)
                 except Exception:
-                    continue  # still unreachable; retry next tick
+                    # Still unreachable; retry next tick.
+                    self._count(
+                        "fedtpu_ft_resync_failures_total",
+                        "heartbeat succeeded but the resync push failed",
+                    )
+                    continue
                 self.registry.mark_alive(client)
                 recovered.append(client)
+            else:
+                self._count(
+                    "fedtpu_ft_heartbeat_misses_total",
+                    "heartbeat probes of dead clients that stayed dead",
+                )
         return recovered
 
     # ------------------------------------------------------- thread runner

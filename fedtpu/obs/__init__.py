@@ -1,0 +1,59 @@
+"""fedtpu.obs — unified telemetry: span tracer, metrics registry, exporters.
+
+The observability subsystem the round/transport/FT stack reports into
+(docs/OBSERVABILITY.md). Three layers:
+
+- :mod:`fedtpu.obs.registry` — thread-safe counters/gauges/histograms;
+- :mod:`fedtpu.obs.trace` — nested spans, Chrome-trace (Perfetto) export,
+  jax ``TraceAnnotation`` bridge;
+- :mod:`fedtpu.obs.exporters` — schema-versioned JSONL round records and
+  Prometheus text dumps.
+
+:class:`Telemetry` bundles them behind ``FedConfig.telemetry``
+(``off | basic | trace``). No jax import at module scope — config-only and
+FT users never pay for a backend.
+"""
+
+from fedtpu.obs.exporters import (
+    SCHEMA_VERSION,
+    RoundRecordWriter,
+    parse_prometheus_text,
+    prometheus_text,
+    read_round_records,
+    write_prometheus,
+)
+from fedtpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_global_registry,
+)
+from fedtpu.obs.telemetry import (
+    NULL_TELEMETRY,
+    TELEMETRY_MODES,
+    Telemetry,
+    validate_telemetry_mode,
+)
+from fedtpu.obs.trace import SpanTracer, load_chrome_trace, write_chrome_trace
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "RoundRecordWriter",
+    "parse_prometheus_text",
+    "prometheus_text",
+    "read_round_records",
+    "write_prometheus",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_global_registry",
+    "NULL_TELEMETRY",
+    "TELEMETRY_MODES",
+    "Telemetry",
+    "validate_telemetry_mode",
+    "SpanTracer",
+    "load_chrome_trace",
+    "write_chrome_trace",
+]

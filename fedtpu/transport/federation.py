@@ -47,6 +47,8 @@ from fedtpu.ft import (
     PrimaryPinger,
     WatchdogRunner,
 )
+from fedtpu.obs import Telemetry
+from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
 from fedtpu.transport.service import (
     TrainerServicer,
@@ -91,6 +93,7 @@ class LocalTrainer:
 
     def __init__(self, cfg: RoundConfig, seed: int = 0):
         self.cfg = cfg
+        self.telemetry = Telemetry(cfg.fed.telemetry)
         n_classes = dataset_info(cfg.data.dataset)[1]
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -121,6 +124,15 @@ class LocalTrainer:
         # the next round's delta (the host-side analogue of
         # fedtpu.ops.compression residuals).
         self.edge_residual = None
+        self.telemetry = Telemetry(cfg.fed.telemetry)
+        # Dense f32 wire size of one full model payload — the denominator
+        # of the compression-ratio gauge (codec bytes / dense bytes).
+        self._dense_bytes = sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(
+                {"params": self.params, "batch_stats": self.batch_stats}
+            )
+        )
 
     def _shard(self, rank: int, world: int):
         """This client's rows of the deterministic ``world``-way partition.
@@ -146,6 +158,20 @@ class LocalTrainer:
     def train_round(self, rank: int, world: int) -> bytes:
         """One local epoch on this client's shard; returns the wire payload
         (trained weights + stats + example count)."""
+        tel = self.telemetry
+        with tel.span("client_train", rank=rank, round=self.round_idx):
+            payload = self._train_round_impl(rank, world)
+        tel.counter(
+            "fedtpu_client_tx_bytes_total",
+            "StartTrain reply payload bytes shipped by this client",
+        ).inc(len(payload))
+        tel.gauge(
+            "fedtpu_client_compression_ratio",
+            "last reply's wire bytes / dense model payload bytes",
+        ).set(len(payload) / max(self._dense_bytes, 1))
+        return payload
+
+    def _train_round_impl(self, rank: int, world: int) -> bytes:
         cfg = self.cfg
         own, own_mask = self._shard(rank, world)
         num_examples = float(own_mask.sum())
@@ -221,11 +247,16 @@ class LocalTrainer:
         return wire.encode(payload, compress=codec != "none")
 
     def set_global(self, data: bytes) -> None:
-        params, stats = _model_template(self.model, self.cfg)
-        tree = wire.decode(data, {"params": params, "batch_stats": stats})
-        self.params = jax.tree.map(jnp.asarray, tree["params"])
-        self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
-        self.synced = True
+        with self.telemetry.span("install_global"):
+            params, stats = _model_template(self.model, self.cfg)
+            tree = wire.decode(data, {"params": params, "batch_stats": stats})
+            self.params = jax.tree.map(jnp.asarray, tree["params"])
+            self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+            self.synced = True
+        self.telemetry.counter(
+            "fedtpu_client_rx_bytes_total",
+            "global-model broadcast bytes received by this client",
+        ).inc(len(data))
 
     def evaluate(self) -> Tuple[float, float]:
         bs = self.cfg.data.eval_batch_size
@@ -305,6 +336,7 @@ class PrimaryServer:
         self.compress = compress
         self.rpc_timeout = rpc_timeout
         self.round_deadline_s = round_deadline_s
+        self.telemetry = Telemetry(cfg.fed.telemetry)
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
         shape = dataset_info(cfg.data.dataset)[0]
         variables = self.model.init(
@@ -365,7 +397,8 @@ class PrimaryServer:
         if initial_model is not None:
             self._install(initial_model)
 
-        self.registry = ClientRegistry(clients)
+        _metrics = self.telemetry.registry if self.telemetry.enabled else None
+        self.registry = ClientRegistry(clients, metrics=_metrics)
         self._stubs: Dict[str, TrainerStub] = {
             c: TrainerStub(create_channel(c, compress=compress)) for c in clients
         }
@@ -378,6 +411,7 @@ class PrimaryServer:
             self.registry,
             probe=lambda c: probe(self._stubs[c]) is not None,
             resync=self._resync,
+            metrics=_metrics,
         )
         self.pinger = (
             PrimaryPinger(self._ping_backup) if self.backup_stub else None
@@ -676,7 +710,43 @@ class PrimaryServer:
 
     # ------------------------------------------------------------ round loop
     def round(self) -> dict:
+        """One synchronous FedAvg round; returns the round record.
+
+        Wraps :meth:`_round_body` in the top-level ``round`` span and feeds
+        the cumulative registry (bytes, phase histograms, straggler counts)
+        after the record is built — both no-ops below their telemetry mode.
+        """
+        tel = self.telemetry
+        with tel.span("round", round=self._round_counter) as rspan:
+            rec = self._round_body(rspan)
+        if tel.enabled:
+            tel.counter(
+                "fedtpu_rounds_completed_total",
+                "synchronous FedAvg rounds completed by this server",
+            ).inc()
+            tel.counter(
+                "fedtpu_rpc_bytes_up_total",
+                "client -> server StartTrain reply bytes (successful)",
+            ).inc(rec["bytes_up"])
+            tel.counter(
+                "fedtpu_rpc_bytes_down_total",
+                "server -> client/backup broadcast bytes (successful)",
+            ).inc(rec["bytes_down"])
+            tel.counter(
+                "fedtpu_stragglers_total",
+                "client-rounds lost to stragglers (deadline, in-flight)",
+            ).inc(rec["stragglers"])
+            for ph in ("collect", "decode", "h2d", "aggregate"):
+                tel.histogram(
+                    "fedtpu_round_phase_seconds",
+                    "per-round phase wall time by phase label",
+                    labels={"phase": ph},
+                ).observe(rec[f"t_{ph}_s"])
+        return rec
+
+    def _round_body(self, rspan) -> dict:
         cfg = self.cfg
+        tel = self.telemetry
         if not self._did_initial_sync:
             self.sync_clients()
         active = self.registry.active_clients()
@@ -726,14 +796,21 @@ class PrimaryServer:
 
         # results[client] = (delta_tree | row_index, num_examples)
         results: Dict[str, tuple] = {}
-        bytes_up = [0]  # client -> server payload bytes this round
+        # Wire + phase accounting: thread-safe counters (fedtpu.obs), NOT
+        # bare mutable cells — collect workers increment them concurrently,
+        # and unsynchronised `x[0] += n` read-modify-writes can drop
+        # updates. Always on (the round record is API, whatever the
+        # telemetry mode).
+        bytes_up = Counter()  # client -> server payload bytes this round
+        bytes_down = Counter()  # only successful sends count
         stream = self.server_pipeline == "stream"
         # Per-round phase timing (satellite of the streaming pipeline):
-        # decode / H2D are summed across clients under the lock; collect and
-        # the post-barrier gap are wall-clock marks in this thread. Reported
+        # decode / H2D are summed across clients; collect and the
+        # post-barrier gap are wall-clock marks in this thread. Reported
         # on the round record so the overlap win shows up in ordinary run
         # logs, not just the microbench.
-        phase = {"decode_s": 0.0, "h2d_s": 0.0}
+        decode_s = Counter()
+        h2d_s = Counter()
         # Streaming collect state: one preallocated host row per launched
         # client (decode target) and ONE device [launch, P] buffer that
         # arriving rows are written into in place (donated
@@ -748,81 +825,99 @@ class PrimaryServer:
         stream_lock = threading.Lock()
 
         def train_one(rank: int, client: str) -> None:
+            # Runs on a collect worker thread: the client span parents to
+            # this round's span EXPLICITLY (thread-local nesting cannot
+            # cross threads); decode/h2d spans below nest under it via the
+            # worker's own stack.
             try:
-                reply = self._stubs[client].StartTrain(
-                    proto.TrainRequest(rank=rank, world=world),
-                    timeout=self.rpc_timeout,
-                )
-                data = reply.message
-                with cache_lock:
-                    bytes_up[0] += len(data)
-                if stream:
-                    # Decode straight into this client's row — no per-leaf
-                    # template trees, no later leaf-by-leaf stacking.
-                    row = host_rows[0][row_of[client]]
-                    t0 = time.monotonic()
-                    if sparse.is_sparse_payload(data):
-                        extra = sparse.decode_into_row(
-                            data, self._flat_layout.sizes, row
+                with tel.span("client_rpc", parent=rspan.id, client=client):
+                    reply = self._stubs[client].StartTrain(
+                        proto.TrainRequest(rank=rank, world=world),
+                        timeout=self.rpc_timeout,
+                    )
+                    data = reply.message
+                    bytes_up.inc(len(data))
+                    if stream:
+                        # Decode straight into this client's row — no
+                        # per-leaf template trees, no later leaf-by-leaf
+                        # stacking.
+                        row = host_rows[0][row_of[client]]
+                        t0 = time.monotonic()
+                        with tel.span("decode", client=client):
+                            if sparse.is_sparse_payload(data):
+                                extra = sparse.decode_into_row(
+                                    data, self._flat_layout.sizes, row
+                                )
+                            else:
+                                # Dense full weights -> delta against the
+                                # round's global, written into the row leaf
+                                # slices.
+                                extra = wire.decode_into_row(
+                                    data,
+                                    _payload_template(self.model, cfg),
+                                    global_host(),
+                                    row,
+                                )
+                        t1 = time.monotonic()
+                        # Ship the row NOW: the transfer (and the in-place
+                        # device-buffer write) overlaps the remaining
+                        # clients' network wait instead of queueing behind
+                        # the barrier. A deadline straggler landing AFTER
+                        # the round closed its buffer (the pop in the
+                        # finalize below) skips the device write: its reply
+                        # is excluded from this round anyway, and writing
+                        # would donate a buffer handle the finalize may
+                        # still be reading.
+                        with tel.span("h2d", client=client):
+                            dev_row = jax.device_put(row)
+                            with stream_lock:
+                                if dev_buf:
+                                    dev_buf[0] = self._set_row(
+                                        dev_buf[0], dev_row, row_of[client]
+                                    )
+                        t2 = time.monotonic()
+                        decode_s.inc(t1 - t0)
+                        h2d_s.inc(t2 - t1)
+                        results[client] = (
+                            row_of[client], float(extra["num_examples"])
+                        )
+                    elif sparse.is_sparse_payload(data):
+                        t0 = time.monotonic()
+                        with tel.span("decode", client=client):
+                            deltas, extra = sparse.decode(
+                                data, delta_template()
+                            )
+                        decode_s.inc(time.monotonic() - t0)
+                        results[client] = (
+                            deltas, float(extra["num_examples"])
                         )
                     else:
-                        # Dense full weights -> delta against the round's
-                        # global, written into the row leaf slices.
-                        extra = wire.decode_into_row(
-                            data,
-                            _payload_template(self.model, cfg),
-                            global_host(),
-                            row,
-                        )
-                    t1 = time.monotonic()
-                    # Ship the row NOW: the transfer (and the in-place
-                    # device-buffer write) overlaps the remaining clients'
-                    # network wait instead of queueing behind the barrier.
-                    # A deadline straggler landing AFTER the round closed
-                    # its buffer (the pop in the finalize below) skips the
-                    # device write: its reply is excluded from this round
-                    # anyway, and writing would donate a buffer handle the
-                    # finalize may still be reading.
-                    dev_row = jax.device_put(row)
-                    with stream_lock:
-                        if dev_buf:
-                            dev_buf[0] = self._set_row(
-                                dev_buf[0], dev_row, row_of[client]
+                        t0 = time.monotonic()
+                        with tel.span("decode", client=client):
+                            tree = wire.decode(
+                                data, _payload_template(self.model, cfg)
                             )
-                    t2 = time.monotonic()
-                    with cache_lock:
-                        phase["decode_s"] += t1 - t0
-                        phase["h2d_s"] += t2 - t1
-                    results[client] = (
-                        row_of[client], float(extra["num_examples"])
-                    )
-                elif sparse.is_sparse_payload(data):
-                    t0 = time.monotonic()
-                    deltas, extra = sparse.decode(data, delta_template())
-                    with cache_lock:
-                        phase["decode_s"] += time.monotonic() - t0
-                    results[client] = (deltas, float(extra["num_examples"]))
-                else:
-                    t0 = time.monotonic()
-                    tree = wire.decode(
-                        data, _payload_template(self.model, cfg)
-                    )
-                    # Dense full weights -> delta against the round's global,
-                    # so dense and sparse replies aggregate uniformly.
-                    delta = jax.tree.map(
-                        lambda a, g: np.asarray(a) - g,
-                        {"params": tree["params"],
-                         "batch_stats": tree["batch_stats"]},
-                        global_host(),
-                    )
-                    with cache_lock:
-                        phase["decode_s"] += time.monotonic() - t0
-                    results[client] = (delta, float(tree["num_examples"]))
+                            # Dense full weights -> delta against the
+                            # round's global, so dense and sparse replies
+                            # aggregate uniformly.
+                            delta = jax.tree.map(
+                                lambda a, g: np.asarray(a) - g,
+                                {"params": tree["params"],
+                                 "batch_stats": tree["batch_stats"]},
+                                global_host(),
+                            )
+                        decode_s.inc(time.monotonic() - t0)
+                        results[client] = (delta, float(tree["num_examples"]))
             except grpc.RpcError as e:
                 log.warning(
                     "client %s failed during StartTrain: %s %s",
                     client, e.code(), e.details(),
                 )
+                tel.counter(
+                    "fedtpu_rpc_failures_total",
+                    "RpcErrors by failing RPC",
+                    labels={"rpc": "StartTrain"},
+                ).inc()
                 self.registry.mark_failed(client)
 
         # A straggler whose previous-round StartTrain is STILL in flight must
@@ -874,30 +969,31 @@ class PrimaryServer:
             host_rows.append(np.zeros((len(launch), padded), np.float32))
             dev_buf.append(jnp.zeros((len(launch), padded), jnp.float32))
         t_launch = time.monotonic()
-        threads = {
-            client: threading.Thread(
-                target=train_one, args=(rank_of[client], client)
-            )
-            for client in launch
-        }
-        for t in threads.values():
-            t.start()
-        if self.round_deadline_s is None:
-            for t in threads.values():
-                t.join()
-            stragglers = still_busy + unsynced
-        else:
-            deadline = time.monotonic() + self.round_deadline_s
-            for t in threads.values():
-                t.join(max(0.0, deadline - time.monotonic()))
-            stragglers = still_busy + unsynced + [
-                c for c, t in threads.items() if t.is_alive()
-            ]
-            if stragglers:
-                log.warning(
-                    "round deadline %.1fs hit; aggregating without %s",
-                    self.round_deadline_s, stragglers,
+        with tel.span("collect", launched=len(launch)):
+            threads = {
+                client: threading.Thread(
+                    target=train_one, args=(rank_of[client], client)
                 )
+                for client in launch
+            }
+            for t in threads.values():
+                t.start()
+            if self.round_deadline_s is None:
+                for t in threads.values():
+                    t.join()
+                stragglers = still_busy + unsynced
+            else:
+                deadline = time.monotonic() + self.round_deadline_s
+                for t in threads.values():
+                    t.join(max(0.0, deadline - time.monotonic()))
+                stragglers = still_busy + unsynced + [
+                    c for c, t in threads.items() if t.is_alive()
+                ]
+                if stragglers:
+                    log.warning(
+                        "round deadline %.1fs hit; aggregating without %s",
+                        self.round_deadline_s, stragglers,
+                    )
         t_barrier = time.monotonic()
         # Merge this round's threads over the surviving prior entries: a
         # straggler launched two rounds ago can still be running even though
@@ -919,52 +1015,60 @@ class PrimaryServer:
             if c in results and c not in stragglers
         }
         if completed:
-            order = [c for c in active if c in completed]
-            if cfg.fed.weighted:
-                weights = jnp.asarray(
-                    [completed[c][1] for c in order], jnp.float32
-                )
-            else:
-                weights = jnp.ones((len(order),), jnp.float32)
-            if stream:
-                # The rows are already device-resident (shipped on arrival)
-                # — the only post-barrier work is ONE fused finalize. Close
-                # the round's buffer under the lock first: a deadline
-                # straggler must not donate-invalidate the handle we are
-                # about to read. When a launched client failed or straggled,
-                # gather the surviving rows so the reduce runs over EXACTLY
-                # the rows the barrier path would stack (same [k, P] shape
-                # -> the same order-stable reduce -> bit parity).
-                with stream_lock:
-                    rows = dev_buf.pop()
-                if order != launch:
-                    rows = rows[
-                        jnp.asarray([row_of[c] for c in order], jnp.int32)
-                    ]
-                new_global, self._server_opt_state = self._finalize_stream(
-                    {"params": self.params, "batch_stats": self.batch_stats},
-                    rows,
-                    weights,
-                    self._server_opt_state,
-                )
-            else:
-                stacked = jax.tree.map(
-                    lambda *leaves: jnp.stack(leaves),
-                    *[completed[c][0] for c in order],
-                )
-                new_global, self._server_opt_state = self._aggregate(
-                    {"params": self.params, "batch_stats": self.batch_stats},
-                    stacked,
-                    weights,
-                    self._server_opt_state,
-                    jnp.asarray(self._round_counter, jnp.int32),
-                )
-            self.params = new_global["params"]
-            self.batch_stats = new_global["batch_stats"]
-            # Block for the timing marks: the broadcast below needs the
-            # values host-side moments later anyway (model_bytes), so this
-            # costs nothing and makes the post-barrier gap honest.
-            jax.block_until_ready(self.params)
+            with tel.span("aggregate", participants=len(completed)):
+                order = [c for c in active if c in completed]
+                if cfg.fed.weighted:
+                    weights = jnp.asarray(
+                        [completed[c][1] for c in order], jnp.float32
+                    )
+                else:
+                    weights = jnp.ones((len(order),), jnp.float32)
+                if stream:
+                    # The rows are already device-resident (shipped on
+                    # arrival) — the only post-barrier work is ONE fused
+                    # finalize. Close the round's buffer under the lock
+                    # first: a deadline straggler must not donate-invalidate
+                    # the handle we are about to read. When a launched
+                    # client failed or straggled, gather the surviving rows
+                    # so the reduce runs over EXACTLY the rows the barrier
+                    # path would stack (same [k, P] shape -> the same
+                    # order-stable reduce -> bit parity).
+                    with stream_lock:
+                        rows = dev_buf.pop()
+                    if order != launch:
+                        rows = rows[
+                            jnp.asarray(
+                                [row_of[c] for c in order], jnp.int32
+                            )
+                        ]
+                    new_global, self._server_opt_state = (
+                        self._finalize_stream(
+                            {"params": self.params,
+                             "batch_stats": self.batch_stats},
+                            rows,
+                            weights,
+                            self._server_opt_state,
+                        )
+                    )
+                else:
+                    stacked = jax.tree.map(
+                        lambda *leaves: jnp.stack(leaves),
+                        *[completed[c][0] for c in order],
+                    )
+                    new_global, self._server_opt_state = self._aggregate(
+                        {"params": self.params,
+                         "batch_stats": self.batch_stats},
+                        stacked,
+                        weights,
+                        self._server_opt_state,
+                        jnp.asarray(self._round_counter, jnp.int32),
+                    )
+                self.params = new_global["params"]
+                self.batch_stats = new_global["batch_stats"]
+                # Block for the timing marks: the broadcast below needs the
+                # values host-side moments later anyway (model_bytes), so
+                # this costs nothing and makes the post-barrier gap honest.
+                jax.block_until_ready(self.params)
         t_done = time.monotonic()
         # Advance the lineage counter BEFORE replication: the replica must
         # carry the next round's index, or a promoted backup would redraw
@@ -972,32 +1076,44 @@ class PrimaryServer:
         self._round_counter += 1
 
         payload = self.model_bytes()
-        bytes_down = [0]  # only successful sends count
         # Backup first (parity: replication before client broadcast,
         # src/server.py:141-153). The backup gets the replica payload —
         # model + server-optimizer moments — not the client payload.
         if self.backup_stub is not None:
             replica = self.replica_bytes()
             try:
-                self.backup_stub.SendModel(
-                    proto.SendModelRequest(model=replica), timeout=self.rpc_timeout
-                )
-                bytes_down[0] += len(replica)
+                with tel.span("replicate", parent=rspan.id):
+                    self.backup_stub.SendModel(
+                        proto.SendModelRequest(model=replica),
+                        timeout=self.rpc_timeout,
+                    )
+                bytes_down.inc(len(replica))
             except grpc.RpcError:
                 log.warning("backup unreachable during replication")
+                tel.counter(
+                    "fedtpu_rpc_failures_total",
+                    "RpcErrors by failing RPC",
+                    labels={"rpc": "Replicate"},
+                ).inc()
 
         def send_one(client: str) -> None:
             try:
-                self._stubs[client].SendModel(
-                    proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
-                )
-                with cache_lock:
-                    bytes_down[0] += len(payload)
+                with tel.span("broadcast", parent=rspan.id, client=client):
+                    self._stubs[client].SendModel(
+                        proto.SendModelRequest(model=payload),
+                        timeout=self.rpc_timeout,
+                    )
+                bytes_down.inc(len(payload))
             except grpc.RpcError as e:
                 log.warning(
                     "client %s failed during SendModel: %s %s",
                     client, e.code(), e.details(),
                 )
+                tel.counter(
+                    "fedtpu_rpc_failures_total",
+                    "RpcErrors by failing RPC",
+                    labels={"rpc": "SendModel"},
+                ).inc()
                 self.registry.mark_failed(client)
 
         # A client whose PREVIOUS round's broadcast is still in flight sits
@@ -1045,8 +1161,8 @@ class PrimaryServer:
             # Wire accounting (successful transfers only) — the reference
             # can't report this at all; its payloads are opaque base64 blobs
             # (src/client.py:21).
-            "bytes_up": bytes_up[0],
-            "bytes_down": bytes_down[0],
+            "bytes_up": int(bytes_up.value),
+            "bytes_down": int(bytes_down.value),
             "pipeline": self.server_pipeline,
             # Phase timing: collect is launch->last join; decode/h2d are
             # summed per-client (overlapped with network wait under
@@ -1054,8 +1170,8 @@ class PrimaryServer:
             # post_barrier is the last-reply -> new-global gap the
             # streaming pipeline exists to shrink.
             "t_collect_s": round(t_barrier - t_launch, 6),
-            "t_decode_s": round(phase["decode_s"], 6),
-            "t_h2d_s": round(phase["h2d_s"], 6),
+            "t_decode_s": round(decode_s.value, 6),
+            "t_h2d_s": round(h2d_s.value, 6),
             "t_aggregate_s": round(t_done - t_barrier, 6),
             "t_post_barrier_s": round(t_done - t_barrier, 6),
         }
@@ -1103,6 +1219,7 @@ class PrimaryServer:
         import queue
 
         fed = self.cfg.fed
+        tel = self.telemetry
         if fed.compression != "none":
             raise ValueError(
                 "run_async requires compression='none': sparse deltas "
@@ -1154,6 +1271,10 @@ class PrimaryServer:
                         proto.SendModelRequest(model=payload),
                         timeout=self.rpc_timeout,
                     )
+                    tel.counter(
+                        "fedtpu_rpc_bytes_down_total",
+                        "server -> client/backup broadcast bytes (successful)",
+                    ).inc(len(payload))
                     reply = self._stubs[client].StartTrain(
                         proto.TrainRequest(
                             # Each client keeps its OWN registry-order shard;
@@ -1163,6 +1284,10 @@ class PrimaryServer:
                         ),
                         timeout=self.rpc_timeout,
                     )
+                    tel.counter(
+                        "fedtpu_rpc_bytes_up_total",
+                        "client -> server StartTrain reply bytes (successful)",
+                    ).inc(len(reply.message))
                     tree = wire.decode(
                         reply.message, _payload_template(self.model, self.cfg)
                     )
@@ -1181,6 +1306,11 @@ class PrimaryServer:
                         "async client %s failed: %s %s",
                         client, e.code(), e.details(),
                     )
+                    tel.counter(
+                        "fedtpu_rpc_failures_total",
+                        "RpcErrors by failing RPC",
+                        labels={"rpc": "AsyncWorker"},
+                    ).inc()
                     self.registry.mark_failed(client)
 
         self.monitor.start()
@@ -1223,7 +1353,7 @@ class PrimaryServer:
                         log.warning("all async clients dead; stopping")
                         break
                     continue
-                with version_lock:
+                with tel.span("async_update"), version_lock:
                     v = self._async_version
                     stalenesses = [v - b for _, _, _, b in buf]
                     raw = [n if fed.weighted else 1.0 for _, _, n, _ in buf]
@@ -1283,6 +1413,19 @@ class PrimaryServer:
                     "alive": self.registry.alive_mask().tolist(),
                 }
                 self.history.append(rec)
+                if tel.enabled:
+                    tel.counter(
+                        "fedtpu_async_updates_total",
+                        "FedBuff server updates applied",
+                    ).inc()
+                    stale_hist = tel.histogram(
+                        "fedtpu_async_staleness",
+                        "staleness (server updates) of buffered deltas at "
+                        "apply time",
+                        buckets=(0, 1, 2, 4, 8, 16, 32, 64),
+                    )
+                    for s in stalenesses:
+                        stale_hist.observe(s)
                 log.info("async update %s", rec)
                 if on_update is not None:
                     on_update(self._async_version, rec)
@@ -1361,10 +1504,14 @@ class BackupServer(TrainerServicer):
         self.round_deadline_s = round_deadline_s
         self.latest_model: Optional[bytes] = None
         self.acting: Optional[PrimaryServer] = None
+        self.telemetry = Telemetry(cfg.fed.telemetry)
         self.machine = FailoverStateMachine(
             timeout=watchdog_timeout,
             on_promote=self._promote,
             on_demote=self._demote,
+            metrics=(
+                self.telemetry.registry if self.telemetry.enabled else None
+            ),
         )
         self.watchdog = WatchdogRunner(self.machine)
         # Per-promotion stop event: a primary flap must not re-arm a stopped

@@ -45,6 +45,11 @@ class MetricsLogger:
     Replaces the reference's print-based observability
     (``src/server.py:121,130,148``) with structured records the driver or a
     dashboard can consume.
+
+    Superseded by :class:`fedtpu.obs.RoundRecordWriter` (same ``log``
+    surface, plus a pinned ``schema_version`` on every record) — the CLIs
+    now write through that; this class stays for callers that want raw,
+    unversioned JSONL.
     """
 
     def __init__(self, path: Optional[str] = None, echo: bool = True):

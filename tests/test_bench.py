@@ -231,3 +231,40 @@ def test_bench_model_wrapper_smoke(tmp_path, monkeypatch):
             os.remove(os.path.join(repo, "artifacts", "SMOKE_BM_TEST.json"))
         except OSError:
             pass
+
+
+def test_telemetry_microbench_contract(bench, monkeypatch, tmp_path):
+    """--telemetry-microbench at a seconds-scale config: schema, artifact
+    emission, and a valid trace-check leg (the <1%-on-densenet acceptance
+    gate itself is pinned by the committed
+    artifacts/TELEMETRY_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_TB_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_TB_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_TB_REPS", "1")
+    result = bench._telemetry_microbench()
+    assert result["metric"] == "telemetry_overhead"
+    # Headline = attributable basic-mode cost: positive, and a real span
+    # (trace) can never be cheaper than the no-op path it replaces.
+    assert result["value"] == result["attributable_pct"]["basic"] > 0
+    assert result["per_round_instrument_us"]["trace"] > \
+        result["per_round_instrument_us"]["basic"]
+    assert result["noise_floor_pct"] >= 0
+    assert set(result["ab_delta_pct"]) == {"basic", "trace"}
+    assert set(result["round_ms"]) == {"off", "basic", "trace"}
+    assert all(v > 0 for v in result["round_ms"].values())
+    assert result["instrument_ns"]["counter_inc"] > 0
+    tc = result["trace_check"]
+    assert tc["rounds"] == 2
+    assert tc["nonnegative_durations"] is True
+    assert tc["phases_nest_under_round"] is True
+    assert all(v > 0 for v in tc["phase_span_counts"].values())
+    # Both artifacts written.
+    assert os.path.exists(os.path.join(str(art), "TELEMETRY_TRACE.json"))
+    path = os.path.join(str(art), "TELEMETRY_MICROBENCH.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result

@@ -1,0 +1,265 @@
+"""Exporter schemas + the telemetry=trace federation smoke (PR 3).
+
+Pins the contracts downstream consumers lean on: JSONL round records
+round-trip with a pinned ``schema_version``, Chrome trace output is
+Perfetto-loadable with non-negative durations and an intact parent chain,
+the Prometheus dump parses, ``tools/jsontail.py`` understands the
+versioned schema — and a real 2-client/2-round gRPC federation at
+``telemetry=trace`` produces non-empty, valid output from BOTH exporters.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+from fedtpu.obs import (
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    RoundRecordWriter,
+    SpanTracer,
+    load_chrome_trace,
+    parse_prometheus_text,
+    prometheus_text,
+    read_round_records,
+    write_chrome_trace,
+    write_prometheus,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools"),
+)
+import jsontail  # noqa: E402
+
+
+# ------------------------------------------------------------------ JSONL
+def test_round_records_roundtrip_with_pinned_schema(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with RoundRecordWriter(path, echo=False) as w:
+        w.log(0, loss=1.25, pipeline="stream", bytes_up=1024)
+        w.log(1, loss=0.5)
+    recs = read_round_records(path)
+    assert [r["step"] for r in recs] == [0, 1]
+    assert all(r["schema_version"] == SCHEMA_VERSION for r in recs)
+    assert SCHEMA_VERSION == 1  # bump deliberately, with a reader update
+    assert recs[0]["loss"] == 1.25
+    assert recs[0]["pipeline"] == "stream"  # non-numeric fields survive
+    assert recs[0]["bytes_up"] == 1024.0
+    assert recs[0]["t"] <= recs[1]["t"]
+
+
+def test_read_round_records_tolerates_legacy_and_garbage(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"step": 0, "loss": 2.0}\n')       # legacy (PR-2) record
+        fh.write("not json at all\n")
+        fh.write('{"step": 1, "loss": 1.0, "schema_version": 1}\n')
+        fh.write('{"truncated": \n')                  # killed writer
+    recs = read_round_records(path)
+    assert [r["schema_version"] for r in recs] == [0, 1]
+
+
+def test_jsontail_understands_versioned_schema():
+    text = "\n".join([
+        '{"step": 0, "loss": 2.0}',                            # v0
+        '{"step": 1, "loss": 1.0, "schema_version": 1}',
+        '{"metric": "not_a_round_record", "value": 3}',        # no step
+        '{"step": 2, "loss": 0.5, "schema_version": 99}',      # future
+        "garbage",
+    ])
+    recs, skipped = jsontail.round_records(text)
+    assert [r["step"] for r in recs] == [0, 1]
+    assert recs[0]["schema_version"] == 0
+    assert skipped == 1  # the future-schema line (bare garbage never counts)
+    assert jsontail.last_round_record(text)["step"] == 1
+    # The import-free tools-side pin must track the real schema version.
+    assert jsontail.ROUND_RECORD_SCHEMA_VERSION == SCHEMA_VERSION
+
+
+# ------------------------------------------------------------ chrome trace
+def test_chrome_trace_validates_nested_nonnegative(tmp_path):
+    tr = SpanTracer()
+    with tr.span("round", round=0) as rs:
+        with tr.span("aggregate"):
+            pass
+
+        def worker():
+            # Cross-thread child: explicit parent, own tid.
+            with tr.span("decode", parent=rs.id, client="c0"):
+                pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    path = str(tmp_path / "trace.json")
+    write_chrome_trace(tr.events(), path)
+
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert isinstance(doc["traceEvents"], list)  # Perfetto-loadable object
+    events = load_chrome_trace(path)
+    assert len(events) == 3
+    by_id = {e["args"]["span_id"]: e for e in events}
+    rnd = by_id[[e for e in events if e["name"] == "round"][0]["args"]["span_id"]]
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] >= 0
+    for name in ("aggregate", "decode"):
+        e = [x for x in events if x["name"] == name][0]
+        # Parent chain AND time containment under the round span.
+        assert e["args"]["parent_id"] == rnd["args"]["span_id"]
+        assert rnd["ts"] <= e["ts"]
+        assert e["ts"] + e["dur"] <= rnd["ts"] + rnd["dur"] + 1e-3
+    assert by_id[rnd["args"]["span_id"]]["args"]["round"] == 0
+
+
+# -------------------------------------------------------------- prometheus
+def test_prometheus_dump_parses(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("fedtpu_rounds_completed_total", "rounds").inc(3)
+    reg.counter("fedtpu_rpc_failures_total", "fails",
+                labels={"rpc": "StartTrain"}).inc()
+    reg.gauge("fedtpu_client_compression_ratio").set(0.125)
+    h = reg.histogram("fedtpu_round_phase_seconds",
+                      labels={"phase": "decode"})
+    for v in (0.002, 0.02, 0.2):
+        h.observe(v)
+    path = str(tmp_path / "m.prom")
+    write_prometheus(reg, path)
+    with open(path) as fh:
+        text = fh.read()
+    assert "# TYPE fedtpu_rounds_completed_total counter" in text
+    assert "# TYPE fedtpu_round_phase_seconds histogram" in text
+    parsed = parse_prometheus_text(text)
+    assert parsed["fedtpu_rounds_completed_total"][""] == 3
+    assert parsed["fedtpu_rpc_failures_total"]["rpc=StartTrain"] == 1
+    assert parsed["fedtpu_client_compression_ratio"][""] == 0.125
+    assert parsed["fedtpu_round_phase_seconds_count"]["phase=decode"] == 3
+    assert parsed["fedtpu_round_phase_seconds_sum"]["phase=decode"] == \
+        pytest.approx(0.222)
+    # Cumulative bucket counts are monotone and end at the total.
+    buckets = sorted(
+        (float(k.split("le=")[1].split(",")[0]), v)
+        for k, v in parsed["fedtpu_round_phase_seconds_bucket"].items()
+        if "+Inf" not in k
+    )
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts) and counts[-1] == 3
+
+
+def test_prometheus_text_matches_own_parser_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(2)
+    text = prometheus_text(reg)
+    assert parse_prometheus_text(text) == {"a_total": {"": 2.0}}
+
+
+def test_registry_rejects_kind_collisions():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+
+
+# ------------------------------------------- tier-1 federation trace smoke
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_two_client_trace_run_feeds_both_exporters(tmp_path):
+    """The CI smoke the ISSUE asks for: a 2-client, 2-round federation with
+    telemetry=trace must leave BOTH exporters with non-empty, valid output
+    — schema-versioned JSONL round records, a parsed Prometheus dump with
+    the expected counts, and a Chrome trace whose decode/h2d/aggregate
+    spans resolve (via parent_id) to a round span that time-contains
+    them."""
+    pytest.importorskip("grpc")
+    from fedtpu.config import (
+        DataConfig, FedConfig, OptimizerConfig, RoundConfig,
+    )
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    cfg = RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(
+            num_clients=2, num_rounds=2, telemetry="trace",
+            server_pipeline="stream",  # exercises the h2d span too
+        ),
+        steps_per_round=2,
+    )
+    servers = []
+    try:
+        addrs = []
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            addrs.append(addr)
+            servers.append(server)
+        primary = PrimaryServer(cfg, addrs)
+
+        metrics_path = str(tmp_path / "metrics.jsonl")
+        writer = RoundRecordWriter(metrics_path, echo=False)
+        # Same shape the server CLI's on_round hook uses.
+        primary.run(num_rounds=2, on_round=lambda r, rec: writer.log(r, **rec))
+        writer.close()
+    finally:
+        for s in servers:
+            s.stop(0)
+
+    # JSONL exporter: 2 versioned records with the wire/phase fields.
+    recs = read_round_records(metrics_path)
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["schema_version"] == SCHEMA_VERSION
+        assert rec["participants"] == 2
+        assert rec["bytes_up"] > 0 and rec["bytes_down"] > 0
+        assert rec["t_collect_s"] > 0 and rec["t_aggregate_s"] >= 0
+
+    # Prometheus exporter: parses, and the counters carry the run.
+    prom_path = str(tmp_path / "metrics.prom")
+    primary.telemetry.export_prometheus(prom_path)
+    with open(prom_path) as fh:
+        parsed = parse_prometheus_text(fh.read())
+    assert parsed["fedtpu_rounds_completed_total"][""] == 2
+    assert parsed["fedtpu_rpc_bytes_up_total"][""] == sum(
+        r["bytes_up"] for r in recs
+    )
+    assert parsed["fedtpu_round_phase_seconds_count"]["phase=decode"] == 2
+
+    # Trace exporter: Perfetto-loadable, phases nest under their round.
+    trace_path = str(tmp_path / "trace.json")
+    primary.telemetry.export_trace(trace_path)
+    events = load_chrome_trace(trace_path)
+    assert events and all(e["dur"] >= 0 for e in events)
+    by_id = {e["args"]["span_id"]: e for e in events}
+
+    def root(e):
+        while "parent_id" in e["args"]:
+            e = by_id[e["args"]["parent_id"]]
+        return e
+
+    rounds = [e for e in events if e["name"] == "round"]
+    assert len(rounds) == 2
+    for name in ("decode", "h2d", "aggregate"):
+        phase_events = [e for e in events if e["name"] == name]
+        assert phase_events, f"no {name} spans"
+        for e in phase_events:
+            r = root(e)
+            assert r["name"] == "round"
+            assert r["ts"] - 1e-3 <= e["ts"]
+            assert e["ts"] + e["dur"] <= r["ts"] + r["dur"] + 1e-3

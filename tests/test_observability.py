@@ -1,5 +1,7 @@
 """Observability + engine knobs: progress bar, profiler hook, local_epochs,
-multihost helpers, wire-byte accounting."""
+multihost helpers, wire-byte accounting, and the PR-3 telemetry stack
+(modes, FT transition events, engine spans). Exporter schemas live in
+tests/test_obs_exporters.py."""
 
 import io
 import os
@@ -171,3 +173,152 @@ def test_debug_per_batch_prints_from_jitted_epoch(capfd):
     quiet.step()
     jax.effects_barrier()
     assert "batch: loss" not in capfd.readouterr().out
+
+
+# ----------------------------------------------------- telemetry (fedtpu.obs)
+def test_telemetry_modes_gate_spans_and_metrics():
+    from fedtpu.obs import Telemetry
+
+    off = Telemetry("off")
+    with off.span("x") as s:
+        assert s.id is None  # shared no-op span
+    off.counter("c").inc()
+    off.histogram("h").observe(1.0)
+    assert off.registry.snapshot() == {}  # nothing reached the registry
+    assert off.trace_events() == []
+
+    basic = Telemetry("basic")
+    basic.counter("c").inc(2)
+    with basic.span("x") as s:
+        assert s.id is None  # metrics yes, spans no
+    assert basic.registry.snapshot()["c"][0]["value"] == 2
+    assert basic.trace_events() == []
+
+    trace = Telemetry("trace")
+    with trace.span("x"):
+        pass
+    assert [e["name"] for e in trace.trace_events()] == ["x"]
+
+    with pytest.raises(ValueError, match="telemetry"):
+        Telemetry("verbose")
+
+
+def test_engine_rejects_bad_telemetry_mode_before_building():
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+
+    with pytest.raises(ValueError, match="telemetry"):
+        Federation(
+            RoundConfig(
+                model="mlp",
+                num_classes=10,
+                data=DataConfig(dataset="synthetic", num_examples=64),
+                fed=FedConfig(num_clients=2, telemetry="loud"),
+            ),
+            seed=0,
+        )
+
+
+def test_engine_step_emits_round_span_and_counter():
+    from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+
+    fed = Federation(
+        RoundConfig(
+            model="mlp",
+            num_classes=10,
+            opt=OptimizerConfig(learning_rate=0.05),
+            data=DataConfig(dataset="synthetic", batch_size=8,
+                            num_examples=64, partition="iid"),
+            fed=FedConfig(num_clients=2, telemetry="trace"),
+            steps_per_round=2,
+        ),
+        seed=0,
+    )
+    fed.step()
+    fed.run_on_device(3)
+    names = [e["name"] for e in fed.telemetry.trace_events()]
+    assert names.count("round") == 1
+    assert names.count("fused_rounds") == 1
+    snap = fed.telemetry.registry.snapshot()
+    assert snap["fedtpu_rounds_completed_total"][0]["value"] == 4
+
+
+def test_client_registry_transitions_are_logged_and_counted(caplog):
+    """Satellite: heartbeat-detected deaths/recoveries are structured
+    events — a log line + a counter — not silent dict flips. Redundant
+    re-marks must NOT inflate the counters."""
+    import logging
+
+    from fedtpu.ft import ClientRegistry
+    from fedtpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    clients = ClientRegistry(["a", "b"], metrics=reg)
+    with caplog.at_level(logging.INFO, logger="fedtpu.ft"):
+        clients.mark_failed("a")
+        clients.mark_failed("a")  # already dead: no event
+        clients.mark_alive("a")
+        clients.mark_alive("a")   # already alive: no event
+        clients.mark_alive("b")   # alive from construction: no event
+    warnings = [r for r in caplog.records if "marked dead" in r.message]
+    recoveries = [r for r in caplog.records if "recovered" in r.message]
+    assert len(warnings) == 1 and "a" in warnings[0].getMessage()
+    assert len(recoveries) == 1
+    snap = reg.snapshot()
+    assert snap["fedtpu_ft_client_deaths_total"][0]["value"] == 1
+    assert snap["fedtpu_ft_client_recoveries_total"][0]["value"] == 1
+
+
+def test_heartbeat_monitor_counts_misses_and_resync_failures():
+    from fedtpu.ft import ClientRegistry, HeartbeatMonitor
+    from fedtpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    clients = ClientRegistry(["a", "b"], metrics=reg)
+    clients.mark_failed("a")
+    clients.mark_failed("b")
+    alive_probe = {"a": False, "b": True}
+    resync_ok = {"b": False}  # heartbeat up but resync push fails once
+
+    def resync(c):
+        if not resync_ok.get(c, True):
+            resync_ok[c] = True
+            raise RuntimeError("push failed")
+
+    mon = HeartbeatMonitor(
+        clients, probe=lambda c: alive_probe[c], resync=resync, metrics=reg,
+    )
+    assert mon.tick() == []        # a: miss; b: probe ok, resync fails
+    assert mon.tick() == ["b"]     # a: miss; b recovers
+    snap = reg.snapshot()
+    assert snap["fedtpu_ft_heartbeat_misses_total"][0]["value"] == 2
+    assert snap["fedtpu_ft_resync_failures_total"][0]["value"] == 1
+    assert snap["fedtpu_ft_client_recoveries_total"][0]["value"] == 1
+
+
+def test_failover_transitions_are_logged_and_counted(caplog):
+    """Satellite: FailoverStateMachine role changes emit log.warning +
+    labelled transition counters (they used to be silent unless the
+    callbacks logged)."""
+    import logging
+
+    from fedtpu.ft import FailoverStateMachine
+    from fedtpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    now = [0.0]
+    m = FailoverStateMachine(timeout=10.0, clock=lambda: now[0], metrics=reg)
+    with caplog.at_level(logging.WARNING, logger="fedtpu.ft"):
+        m.on_ping(recovering=False)
+        now[0] = 11.0
+        assert m.check_watchdog() is True   # backup -> acting_primary
+        assert m.on_ping(recovering=True) == 1  # acting -> backup
+    msgs = [r.getMessage() for r in caplog.records if "failover:" in r.message]
+    assert any("backup -> acting_primary" in s for s in msgs)
+    assert any("acting_primary -> backup" in s for s in msgs)
+    snap = reg.snapshot()
+    by_label = {
+        tuple(sorted(e["labels"].items())): e["value"]
+        for e in snap["fedtpu_ft_failover_transitions_total"]
+    }
+    assert by_label[(("to", "acting_primary"),)] == 1
+    assert by_label[(("to", "backup"),)] == 1

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Human-readable report from a round-record JSONL (+ optional trace) pair.
+
+Turns the telemetry exporters' output back into the question operators
+actually ask — *where did the round time and the wire bytes go?*:
+
+    python tools/metrics_report.py metrics.jsonl
+    python tools/metrics_report.py metrics.jsonl --trace trace.json
+
+- The JSONL is the ``--metrics`` file a run/server CLI wrote
+  (``fedtpu.obs.RoundRecordWriter``; legacy unversioned records are read
+  as schema v0). Phase columns appear for whichever ``t_*_s`` fields the
+  records carry (the distributed server's records carry
+  collect/decode/h2d/aggregate/post_barrier).
+- The trace is a ``--trace-out`` Chrome-trace dump; per-span-name and
+  per-client aggregates come from it (span ``args.client`` labels the
+  collect workers and broadcast sends).
+
+Pure stdlib on purpose: this must run anywhere the JSONL landed, including
+boxes with no jax install.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from jsontail import round_records  # noqa: E402
+
+# Round-record phase fields, in pipeline order (server rounds carry all of
+# these; engine-CLI records carry none and just get the scalar summary).
+PHASES = ("t_collect_s", "t_decode_s", "t_h2d_s", "t_aggregate_s",
+          "t_post_barrier_s")
+
+
+def _stats(values):
+    values = sorted(values)
+    n = len(values)
+    return {
+        "n": n,
+        "mean": sum(values) / n,
+        "p50": values[n // 2],
+        "max": values[-1],
+    }
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+
+
+def report_records(records, skipped, out=sys.stdout):
+    w = out.write
+    if not records:
+        w("no round records found\n")
+        return
+    versions = sorted({r["schema_version"] for r in records})
+    w(f"rounds: {len(records)}  (schema versions: "
+      f"{', '.join(map(str, versions))}"
+      + (f"; {skipped} lines skipped" if skipped else "") + ")\n")
+
+    numeric = {}
+    for key in ("participants", "stragglers", "loss", "acc", "test_acc"):
+        vals = [r[key] for r in records if isinstance(r.get(key), (int, float))]
+        if vals:
+            numeric[key] = _stats(vals)
+    if numeric:
+        w("\n  field          n     mean       p50       max\n")
+        for key, s in numeric.items():
+            w(f"  {key:<13}{s['n']:>4}  {s['mean']:>8.4f}  {s['p50']:>8.4f}"
+              f"  {s['max']:>8.4f}\n")
+
+    up = sum(r.get("bytes_up", 0) for r in records)
+    down = sum(r.get("bytes_down", 0) for r in records)
+    if up or down:
+        w(f"\nwire: {_fmt_bytes(up)} up, {_fmt_bytes(down)} down "
+          f"({_fmt_bytes(up / len(records))}/round up, "
+          f"{_fmt_bytes(down / len(records))}/round down)\n")
+
+    phase_rows = [
+        (key, _stats([r[key] for r in records if key in r]))
+        for key in PHASES
+        if any(key in r for r in records)
+    ]
+    if phase_rows:
+        # Share of the round attributed against collect+aggregate wall
+        # (decode/h2d overlap collect under the streaming pipeline, so
+        # shares can exceed 100% — that overlap is the point).
+        wall = sum(
+            r.get("t_collect_s", 0) + r.get("t_aggregate_s", 0)
+            for r in records
+        )
+        w("\n  phase             mean ms    p50 ms    max ms   % of wall\n")
+        for key, s in phase_rows:
+            total = s["mean"] * s["n"]
+            share = 100.0 * total / wall if wall else 0.0
+            name = key[2:-2]  # t_collect_s -> collect
+            w(f"  {name:<15}{s['mean'] * 1e3:>10.2f}{s['p50'] * 1e3:>10.2f}"
+              f"{s['max'] * 1e3:>10.2f}{share:>11.1f}\n")
+        w("  (decode/h2d overlap collect under server_pipeline=stream;"
+          " shares are of collect+aggregate wall)\n")
+
+
+def report_trace(events, out=sys.stdout):
+    w = out.write
+    if not events:
+        w("\nno trace events\n")
+        return
+    by_name, by_client = {}, {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e["dur"])
+        client = e.get("args", {}).get("client")
+        if client is not None:
+            by_client.setdefault(client, {}).setdefault(
+                e["name"], []
+            ).append(e["dur"])
+    w(f"\ntrace: {len(events)} spans\n")
+    w("\n  span            count   total ms    mean ms     max ms\n")
+    for name, durs in sorted(
+        by_name.items(), key=lambda kv: -sum(kv[1])
+    ):
+        w(f"  {name:<15}{len(durs):>6}{sum(durs) / 1e3:>11.2f}"
+          f"{sum(durs) / len(durs) / 1e3:>11.2f}{max(durs) / 1e3:>11.2f}\n")
+    if by_client:
+        w("\n  per-client (total ms by span):\n")
+        names = sorted({n for spans in by_client.values() for n in spans})
+        w("  client".ljust(24) + "".join(f"{n:>12}" for n in names) + "\n")
+        for client in sorted(by_client):
+            row = "  " + str(client).ljust(22)
+            for n in names:
+                durs = by_client[client].get(n)
+                row += f"{sum(durs) / 1e3:>12.2f}" if durs else f"{'-':>12}"
+            w(row + "\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics", help="round-record JSONL path (--metrics file)")
+    p.add_argument("--trace", default=None,
+                   help="Chrome trace JSON path (--trace-out file)")
+    args = p.parse_args(argv)
+
+    with open(args.metrics) as fh:
+        records, skipped = round_records(fh.read())
+    report_records(records, skipped)
+    if args.trace:
+        with open(args.trace) as fh:
+            doc = json.load(fh)
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        report_trace(events)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

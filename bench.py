@@ -785,6 +785,154 @@ def _telemetry_trace_leg():
             s.stop(0)
 
 
+def _obs_plane_microbench():
+    """``obs_plane_overhead``: what the federation-wide observability plane
+    costs per round — trace-context metadata injection/extraction on every
+    RPC (fedtpu.obs.propagate) plus the round loop's live status feed
+    (StatusBoard updates behind /statusz).
+
+    Same two-measurement methodology as ``--telemetry-microbench`` (PR 3),
+    because the effect sizes are again microseconds against seconds-scale
+    rounds:
+
+    - **Attributable cost** (the headline ``value``): the EXACT per-round
+      obs-plane sequence — one context encode + one metadata extract per
+      client RPC, and the round loop's four status-board updates — timed
+      directly in a tight loop and scaled by the bare round wall of a
+      densenet_cifar CPU round with ``FEDTPU_OB_CLIENTS`` clients.
+      Acceptance gate: <= 1% (``gate_pct`` / ``passes_gate``).
+    - **A/B walls (audit)**: the same compiled engine driven with and
+      without the explicit per-round obs-plane sequence bolted on, mode
+      order rotated every rep, medians next to the bare trials' own
+      spread (``noise_floor_pct``) — demonstrating the delta sits inside
+      run-to-run jitter, exactly like PR 3's phantom-overhead analysis.
+
+    Run via ``python bench.py --obs-plane-microbench``; prints one JSON
+    line and writes ``artifacts/OBS_PLANE_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.obs import StatusBoard
+    from fedtpu.obs import propagate
+
+    model_name = os.environ.get("FEDTPU_OB_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_OB_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_OB_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_OB_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_OB_BATCH", "8"))
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=10,
+        data=DataConfig(
+            dataset="cifar10", batch_size=batch, partition="iid",
+            num_examples=clients * batch * 4,
+        ),
+        fed=FedConfig(num_clients=clients, telemetry="off"),
+        steps_per_round=1,
+    )
+    fed = Federation(cfg, seed=0)
+
+    # The per-RPC and per-round sequences under test, shaped exactly like
+    # the production path: a realistic context (ids in the range a long run
+    # reaches), the real wire key, a real status board.
+    ctx = propagate.TraceContext(
+        trace_id="a3f1c09d5e7b2468", span_id=123456, role="primary",
+        round=10_000,
+    )
+    wire_md = [("fedtpu-trace-bin", propagate.encode_context(ctx))]
+    board = StatusBoard(role="primary", phase="init", round=0)
+
+    def obs_round_sequence(r: int) -> None:
+        board.update(round=r, phase="collect")
+        for _ in range(clients):
+            propagate.from_metadata(
+                [("fedtpu-trace-bin", propagate.encode_context(ctx))]
+            )
+        board.update(phase="aggregate")
+        board.update(phase="broadcast")
+        board.update(phase="idle")
+
+    def run_block(with_obs: bool):
+        for r in range(rounds):
+            if with_obs:
+                obs_round_sequence(r)
+            m = fed.step()
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+
+    run_block(False)  # compile + warmup
+    modes = ("bare", "obs")
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        # Rotate mode order per rep — fixed ordering turns machine drift
+        # into phantom overhead (see _telemetry_microbench).
+        for mode in modes if rep % 2 == 0 else modes[::-1]:
+            t0 = time.perf_counter()
+            run_block(mode == "obs")
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = (med["obs"] - med["bare"]) / med["bare"] * 100.0
+    noise_floor_pct = (
+        (max(trials["bare"]) - min(trials["bare"])) / med["bare"] * 100.0
+    )
+
+    # Attributable cost: direct timing of the exact instrument sequences.
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        propagate.encode_context(ctx)
+    inject_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for _ in range(n):
+        propagate.from_metadata(wire_md)
+    extract_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for i in range(n):
+        board.update(round=i, phase="collect")
+        board.update(phase="aggregate")
+        board.update(phase="broadcast")
+        board.update(phase="idle")
+    status_us = (time.perf_counter() - t0) / n * 1e6
+    per_round_us = clients * (inject_us + extract_us) + status_us
+    attributable_pct = per_round_us / (med["bare"] * 1e6) * 100.0
+
+    result = {
+        "metric": "obs_plane_overhead",
+        "unit": "% of round wall time attributable to trace propagation + "
+                "status feed",
+        "value": round(attributable_pct, 6),
+        "gate_pct": 1.0,
+        "passes_gate": bool(attributable_pct <= 1.0),
+        "per_rpc_us": {
+            "inject": round(inject_us, 3),
+            "extract": round(extract_us, 3),
+        },
+        "per_round_status_us": round(status_us, 3),
+        "per_round_obs_us": round(per_round_us, 3),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "OBS_PLANE_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
@@ -894,6 +1042,9 @@ def main():
         return
     if "--telemetry-microbench" in sys.argv:
         print(json.dumps(_telemetry_microbench()))
+        return
+    if "--obs-plane-microbench" in sys.argv:
+        print(json.dumps(_obs_plane_microbench()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

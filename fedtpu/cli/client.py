@@ -15,10 +15,15 @@ import logging
 from fedtpu.cli.common import (
     add_compression_flags,
     add_model_flags,
+    add_obs_flags,
     add_platform_flag,
+    add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     compress_enabled,
+    install_final_flush,
+    make_flight_recorder,
+    start_obs_server,
 )
 from fedtpu.transport.federation import serve_client
 
@@ -28,6 +33,17 @@ def main(argv=None) -> int:
     add_platform_flag(p)
     add_model_flags(p)
     add_compression_flags(p)
+    p.add_argument(
+        "--telemetry",
+        default="basic",
+        choices=["off", "basic", "trace"],
+        help="client-side self-measurement level (fedtpu.obs). At 'trace' "
+        "the client's spans adopt the coordinator's propagated trace "
+        "context (fedtpu-trace-bin metadata), so its --trace-out dump "
+        "merges under the coordinator's rounds via tools/trace_merge.py",
+    )
+    add_telemetry_export_flags(p)
+    add_obs_flags(p)
     p.add_argument("-a", "--address", default="localhost:50051",
                    help="bind address (doubles as the client's identity)")
     p.add_argument("--world", default=2, type=int,
@@ -40,11 +56,25 @@ def main(argv=None) -> int:
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
     cfg = build_config(args, num_clients=args.world)
-    server, _agent = serve_client(
+    server, agent = serve_client(
         args.address, cfg, seed=args.seed, compress=compress_enabled(args)
     )
+    # A client agent exits via signal (it serves until terminated), so the
+    # exporters ONLY fire through the SIGTERM/atexit flush.
+    install_final_flush(args, agent.trainer.telemetry)
+    flight = make_flight_recorder(
+        f"client-{args.address}", telemetry=agent.trainer.telemetry
+    )
+    obs = start_obs_server(
+        args, registry=agent.trainer.telemetry.registry,
+        status_fn=agent.status_snapshot, flight=flight,
+    )
     logging.info("client agent serving on %s", args.address)
-    server.wait_for_termination()
+    try:
+        server.wait_for_termination()
+    finally:
+        if obs is not None:
+            obs.stop()
     return 0
 
 

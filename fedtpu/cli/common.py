@@ -259,6 +259,102 @@ def export_telemetry(args, telemetry) -> None:
             )
 
 
+def install_final_flush(args, telemetry, metrics=None):
+    """Crash-proof the exit-time exporters: --prom-out/--trace-out (and the
+    --metrics JSONL close) used to run only on a clean fall-through to the
+    CLI's ``finally`` — a SIGTERM (scheduler preemption, ``timeout``,
+    ``kill``) bypassed them and lost the whole registry/trace. Registers
+    ONE idempotent flush on ``atexit`` + SIGTERM (the handler re-raises
+    ``SystemExit`` so the normal ``finally`` path still unwinds), and
+    returns it so the CLI's own ``finally`` calls the same function —
+    whoever fires first wins, everyone else no-ops.
+
+    Per-record durability needs no handler at all: ``RoundRecordWriter``
+    appends + flushes every line, so even SIGKILL keeps all completed
+    round records (tested: tests/test_obs_propagation.py kills a run
+    mid-flight and parses complete v1 records).
+    """
+    import atexit
+    import logging
+    import signal
+    import threading
+
+    done = threading.Event()
+
+    def flush() -> None:
+        if done.is_set():
+            return
+        done.set()
+        try:
+            if metrics is not None:
+                metrics.close()
+        except Exception:
+            logging.exception("final metrics close failed")
+        try:
+            export_telemetry(args, telemetry)
+        except Exception:
+            logging.exception("final telemetry export failed")
+
+    atexit.register(flush)
+
+    def _on_term(signum, frame):
+        flush()
+        raise SystemExit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (library/test use); atexit still covers
+    return flush
+
+
+def add_obs_flags(p: argparse.ArgumentParser) -> None:
+    """The live introspection plane (fedtpu.obs.http; docs/OBSERVABILITY.md)."""
+    p.add_argument(
+        "--obs-port",
+        default=None,
+        type=int,
+        metavar="PORT",
+        help="serve live introspection HTTP on 127.0.0.1:PORT: /metrics "
+        "(Prometheus text from the cumulative registry), /healthz, "
+        "/statusz (JSON: round, phase, client liveness, failover role, "
+        "last-round phase timings — render live with tools/statusz.py), "
+        "/flightz (the crash flight recorder's ring buffer). Off by "
+        "default; 0 binds an ephemeral port (logged)",
+    )
+
+
+def start_obs_server(args, registry=None, status_fn=None, flight=None):
+    """Honor --obs-port: start (and return) the endpoint, or None when the
+    flag is absent. The caller owns stop()."""
+    import logging
+
+    port = getattr(args, "obs_port", None)
+    if port is None:
+        return None
+    from fedtpu.obs import ObsServer
+
+    obs = ObsServer(
+        port=port, registry=registry, status_fn=status_fn, flight=flight
+    ).start()
+    logging.info(
+        "obs endpoint on %s (/metrics /healthz /statusz /flightz)", obs.url
+    )
+    return obs
+
+
+def make_flight_recorder(role: str, telemetry=None):
+    """One process-wide flight recorder for a CLI entrypoint: ring buffer +
+    dump hooks armed (unhandled exception, SIGUSR1), warning+ log capture,
+    and — in trace mode — span completions via the tracer sink."""
+    from fedtpu.obs import FlightRecorder
+
+    flight = FlightRecorder(role=role).install()
+    if telemetry is not None and telemetry.tracer is not None:
+        telemetry.tracer.sink = flight.record_span
+    return flight
+
+
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
     compress = str(getattr(args, "compressFlag", "N")).upper() == "Y"
     compression = getattr(args, "compression", None)

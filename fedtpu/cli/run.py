@@ -18,11 +18,14 @@ from fedtpu.checkpoint import Checkpointer
 from fedtpu.cli.common import (
     add_fed_flags,
     add_model_flags,
+    add_obs_flags,
     add_platform_flag,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
-    export_telemetry,
+    install_final_flush,
+    make_flight_recorder,
+    start_obs_server,
 )
 from fedtpu.core import Federation
 from fedtpu.data import load
@@ -88,6 +91,7 @@ def main(argv=None) -> int:
         "tools/metrics_report.py)",
     )
     add_telemetry_export_flags(p)
+    add_obs_flags(p)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
     p.add_argument("-r", "--resume", action="store_true")
@@ -118,6 +122,14 @@ def main(argv=None) -> int:
         logging.info("resumed from round %d", start_round)
 
     logger = RoundRecordWriter(path=args.metrics, echo=not args.progress)
+    flight = make_flight_recorder("engine", telemetry=fed.telemetry)
+    flush = install_final_flush(args, fed.telemetry, metrics=logger)
+    obs = start_obs_server(
+        args,
+        registry=fed.telemetry.registry,
+        status_fn=fed.status_snapshot,
+        flight=flight,
+    )
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
     )
@@ -190,7 +202,11 @@ def main(argv=None) -> int:
     logging.info(
         "%d rounds in %.1fs (%.2f rounds/s)", done, dt, done / max(dt, 1e-9)
     )
-    export_telemetry(args, fed.telemetry)
+    # Idempotent with the atexit/SIGTERM registration — crash paths flush
+    # the same way this clean exit does.
+    flush()
+    if obs is not None:
+        obs.stop()
     return 0
 
 
@@ -247,6 +263,14 @@ def _run_async(args, cfg) -> int:
         fed.load_state(state)  # async re-placement (mesh-aware)
         logging.info("resumed async state from update %d", start_tick)
     logger = RoundRecordWriter(path=args.metrics, echo=True)
+    flight = make_flight_recorder("async_engine", telemetry=fed.telemetry)
+    flush = install_final_flush(args, fed.telemetry, metrics=logger)
+    obs = start_obs_server(
+        args,
+        registry=fed.telemetry.registry,
+        status_fn=fed.status_snapshot,
+        flight=flight,
+    )
     eval_data = load(
         args.dataset, "test", seed=args.seed, num=args.num_examples
     )
@@ -261,7 +285,9 @@ def _run_async(args, cfg) -> int:
         "%d async updates in %.1fs (%.2f updates/s)",
         done, dt, done / max(dt, 1e-9),
     )
-    export_telemetry(args, fed.telemetry)
+    flush()
+    if obs is not None:
+        obs.stop()
     return 0
 
 

@@ -20,12 +20,15 @@ from fedtpu.checkpoint import Checkpointer
 from fedtpu.cli.common import (
     add_fed_flags,
     add_model_flags,
+    add_obs_flags,
     add_platform_flag,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     compress_enabled,
-    export_telemetry,
+    install_final_flush,
+    make_flight_recorder,
+    start_obs_server,
 )
 from fedtpu.transport.federation import BackupServer, PrimaryServer, _model_template
 
@@ -55,6 +58,7 @@ def main(argv=None) -> int:
         "with tools/metrics_report.py)",
     )
     add_telemetry_export_flags(p)
+    add_obs_flags(p)
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
     p.add_argument("--watchdog-timeout", default=10.0, type=float)
@@ -97,12 +101,16 @@ def main(argv=None) -> int:
     compress = compress_enabled(args)
 
     if str(args.p).lower() == "y":
+        # Process-wide black box: armed before anything can fail, handed to
+        # the server so spans/rounds/FT events feed the same ring.
+        flight = make_flight_recorder("primary")
         primary = PrimaryServer(
             cfg,
             clients,
             backup_address=f"{args.backupAddress}:{args.backupPort}",
             compress=compress,
             round_deadline_s=args.round_deadline,
+            flight=flight,
         )
         ckpt = None
         start_round = 0
@@ -142,6 +150,15 @@ def main(argv=None) -> int:
         from fedtpu.obs import RoundRecordWriter
 
         metrics = RoundRecordWriter(path=args.metrics) if args.metrics else None
+        # Exit-time exporters must survive SIGTERM, not just clean exits;
+        # the same idempotent flush also serves the finally below.
+        flush = install_final_flush(args, primary.telemetry, metrics=metrics)
+        obs = start_obs_server(
+            args,
+            registry=primary.telemetry.registry,
+            status_fn=primary.status_snapshot,
+            flight=flight,
+        )
 
         def on_round(r: int, rec: dict) -> None:
             if metrics is not None:
@@ -166,23 +183,33 @@ def main(argv=None) -> int:
                     on_round=on_round,
                 )
         finally:
-            if metrics is not None:
-                metrics.close()
-            export_telemetry(args, primary.telemetry)
+            flush()
+            if obs is not None:
+                obs.stop()
         return 0
 
+    flight = make_flight_recorder("backup")
     backup = BackupServer(
         cfg, clients, compress=compress,
         watchdog_timeout=args.watchdog_timeout,
         round_deadline_s=args.round_deadline,
+        flight=flight,
     )
     server = backup.start(args.listen)
+    obs = start_obs_server(
+        args,
+        registry=backup.telemetry.registry,
+        status_fn=backup.status_snapshot,
+        flight=flight,
+    )
     logging.info("backup serving on %s", args.listen)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         backup.watchdog.stop()
+        if obs is not None:
+            obs.stop()
         server.stop(0)
     return 0
 

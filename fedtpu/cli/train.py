@@ -13,15 +13,24 @@ from __future__ import annotations
 import argparse
 import logging
 
-from fedtpu.cli.common import add_model_flags, add_platform_flag, apply_platform_flag, build_config
+from fedtpu.cli.common import (
+    add_model_flags,
+    add_obs_flags,
+    add_platform_flag,
+    apply_platform_flag,
+    build_config,
+    make_flight_recorder,
+    start_obs_server,
+)
 from fedtpu.core.solo import run_solo
-from fedtpu.obs import RoundRecordWriter
+from fedtpu.obs import RoundRecordWriter, StatusBoard
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     add_platform_flag(p)
     add_model_flags(p)
+    add_obs_flags(p)
     p.add_argument("--epochs", default=200, type=int,
                    help="training epochs (reference default: 200)")
     p.add_argument("--checkpoint", default="./checkpoint/solo.fckpt",
@@ -53,16 +62,33 @@ def main(argv=None) -> int:
 
             mesh = client_mesh(axis_name="batch")
             logging.info("batch axis sharded over %d devices", n_dev)
+    # Solo has no Telemetry registry; its /statusz feed is the per-epoch
+    # record mirrored onto a StatusBoard by the logger wrapper below.
+    status = StatusBoard(role="solo", phase="train", round=0)
+    flight = make_flight_recorder("solo")
+    obs = start_obs_server(args, status_fn=status.snapshot, flight=flight)
+
+    class _StatusLogger(RoundRecordWriter):
+        def log(self, step: int, **fields) -> None:
+            status.update(
+                round=step,
+                **{k: v for k, v in fields.items()
+                   if isinstance(v, (int, float))},
+            )
+            super().log(step, **fields)
+
     trainer = run_solo(
         cfg,
         epochs=args.epochs,
         seed=args.seed,
         checkpoint_path=args.checkpoint,
         resume=args.resume,
-        logger=RoundRecordWriter(path=args.metrics),
+        logger=_StatusLogger(path=args.metrics),
         mesh=mesh,
     )
     logging.info("best test accuracy: %.4f", trainer.best_acc)
+    if obs is not None:
+        obs.stop()
     return 0
 
 

@@ -546,8 +546,12 @@ class AsyncFederation:
         # its sync jits are lazy and never compiled unless used.
         self._fed = Federation(cfg, seed=seed, data=data, mesh=mesh)
         # Shared telemetry with the delegate: one registry/tracer per
-        # federation instance, whichever loop is driving.
+        # federation instance, whichever loop is driving. The status feed
+        # is shared too — /statusz shows async ticks through the same board
+        # (role re-stamped so the feed says which loop drives).
         self.telemetry = self._fed.telemetry
+        self.status = self._fed.status
+        self.status.update(role="async_engine")
         self.model = self._fed.model
         sample = jnp.zeros(
             (1,) + tuple(self._fed.images.shape[1:]), jnp.float32
@@ -612,8 +616,17 @@ class AsyncFederation:
         return arrive
 
     # ---------------------------------------------------------------- ticks
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed (async twin of ``Federation.status_snapshot``)."""
+        snap = self.status.snapshot()
+        snap["alive"] = self.alive.tolist()
+        if self.telemetry.tracer is not None:
+            snap["trace_id"] = self.telemetry.tracer.trace_id
+        return snap
+
     def tick(self) -> AsyncMetrics:
         """One server update: everyone trains, ``buffer_k`` clients report."""
+        self.status.update(round=self._tick_host, phase="async_tick")
         with self.telemetry.span("async_tick", tick=self._tick_host):
             d_images, d_labels, d_idx, d_mask = (
                 self._fed._ensure_device_data()
@@ -630,6 +643,7 @@ class AsyncFederation:
                 self._fed._data_key,
             )
         self._tick_host += 1
+        self.status.update(round=self._tick_host, phase="idle")
         self.telemetry.counter(
             "fedtpu_async_updates_total",
             "simulated FedBuff server updates dispatched",
@@ -667,6 +681,10 @@ class AsyncFederation:
                     staleness_damping=self.staleness_damping,
                 )
         d_images, d_labels, d_idx, d_mask = self._fed._ensure_device_data()
+        self.status.update(
+            round=self._tick_host, phase="fused_ticks",
+            fused_block=num_ticks,
+        )
         with self.telemetry.span(
             "fused_ticks", tick=self._tick_host, num_ticks=num_ticks
         ):
@@ -682,6 +700,7 @@ class AsyncFederation:
                 self._fed._data_key,
             )
         self._tick_host += num_ticks
+        self.status.update(round=self._tick_host, phase="idle")
         self.telemetry.counter(
             "fedtpu_async_updates_total",
             "simulated FedBuff server updates dispatched",

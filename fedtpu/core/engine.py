@@ -32,7 +32,7 @@ from fedtpu.core.round import (
 )
 from fedtpu.core.client import make_eval_fn
 from fedtpu.data import data_source, dataset_info, load, partition
-from fedtpu.obs import Telemetry, validate_telemetry_mode
+from fedtpu.obs import StatusBoard, Telemetry, validate_telemetry_mode
 from fedtpu.utils.metrics import MetricsLogger
 
 # NOTE: fedtpu.data.device imports from fedtpu.core.round, whose package
@@ -220,7 +220,22 @@ class Federation:
         # rounds completed. Swappable post-construction — the jitted
         # programs never close over it (bench.py --telemetry-microbench
         # retimes one engine under all three modes).
-        self.telemetry = Telemetry(cfg.fed.telemetry)
+        self.telemetry = Telemetry(cfg.fed.telemetry, role="engine")
+        # Live status feed (fedtpu.obs.http: /statusz via --obs-port):
+        # round/phase updates are one locked dict merge each — cheap enough
+        # to run unconditionally (bench.py --obs-plane-microbench).
+        self.status = StatusBoard(
+            role="engine", phase="init", round=0,
+            num_clients=cfg.fed.num_clients,
+        )
+
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed: live round/phase plus the alive mask."""
+        snap = self.status.snapshot()
+        snap["alive"] = self.alive.tolist()
+        if self.telemetry.tracer is not None:
+            snap["trace_id"] = self.telemetry.tracer.trace_id
+        return snap
 
     def _placed(self, x, sharded: bool):
         """Place an array for the active topology: sharded along the clients
@@ -400,8 +415,11 @@ class Federation:
 
     def step(self, batch: Optional[RoundBatch] = None) -> RoundMetrics:
         tel = self.telemetry
-        with tel.span("round", round=self._round_number()):
+        r = self._round_number()
+        self.status.update(round=r, phase="round")
+        with tel.span("round", round=r):
             metrics = self._step_impl(batch)
+        self.status.update(round=r + 1, phase="idle")
         tel.counter(
             "fedtpu_rounds_completed_total",
             "simulated FedAvg rounds dispatched by this engine",
@@ -473,6 +491,8 @@ class Federation:
             raise ValueError(f"num_rounds must be >= 1, got {num_rounds}")
         tel = self.telemetry
         r = self._round_number()
+        self.status.update(round=r, phase="fused_rounds",
+                           fused_block=num_rounds)
         with tel.span("fused_rounds", round=r, num_rounds=num_rounds):
             alive = np.stack(
                 [self._alive_for_round(r + i) for i in range(num_rounds)]
@@ -496,6 +516,7 @@ class Federation:
                 self._data_key,
             )
         self._round_host = r + num_rounds
+        self.status.update(round=r + num_rounds, phase="idle")
         tel.counter(
             "fedtpu_rounds_completed_total",
             "simulated FedAvg rounds dispatched by this engine",

@@ -60,12 +60,18 @@ class FailoverStateMachine:
         clock: Callable[[], float] = time.monotonic,
         arm_without_ping: bool = False,
         metrics: Optional[object] = None,
+        flight: Optional[object] = None,
     ):
+        """``flight``: a :class:`fedtpu.obs.FlightRecorder` — every role
+        transition is recorded into it AND triggers a dump, because the
+        moments before a promote/demote are exactly the telemetry the lost
+        primary's exit-time exporters never wrote."""
         self.timeout = timeout
         self.on_promote = on_promote
         self.on_demote = on_demote
         self.clock = clock
         self._metrics = metrics
+        self._flight = flight
         self.role = Role.BACKUP
         # The watchdog arms only once a primary has been heard at least once
         # (deliberate divergence: the reference self-promotes ~10 s after
@@ -83,6 +89,11 @@ class FailoverStateMachine:
                 "role transitions by destination role",
                 labels={"to": dst.value},
             ).inc()
+        if self._flight is not None:
+            self._flight.record(
+                "failover", src=src.value, dst=dst.value, why=why
+            )
+            self._flight.dump(reason=f"failover:{dst.value}")
 
     def on_ping(self, recovering: bool) -> int:
         """Handle one CheckIfPrimaryUp; returns the PingResponse value
@@ -145,15 +156,27 @@ class PrimaryPinger:
         send: Callable[[bool], Optional[int]],
         period: float = 1.0,
         recovering: bool = True,
+        metrics: Optional[object] = None,
     ):
         self.send = send
         self.period = period
         self.recovering = recovering
+        self._metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def tick(self) -> Optional[int]:
+        # Timed like the heartbeat probes (fedtpu_ft_rpc_seconds): the
+        # backup-ping RTT trend is the primary's view of control-plane
+        # health, and it previously went unmeasured.
+        t0 = time.perf_counter()
         result = self.send(self.recovering)
+        if self._metrics is not None:
+            self._metrics.histogram(
+                "fedtpu_ft_rpc_seconds",
+                "FT control-plane RPC round-trip seconds by rpc",
+                labels={"rpc": "CheckIfPrimaryUp"},
+            ).observe(time.perf_counter() - t0)
         if result is not None:
             # Delivered: the backup has seen our recovering flag.
             self.recovering = False

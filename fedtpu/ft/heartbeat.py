@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -133,7 +134,18 @@ class HeartbeatMonitor:
         """
         recovered = []
         for client in self.registry.dead_clients():
-            if self.probe(client):
+            # Time the probe round-trip: these control-plane RPCs used to
+            # count misses but never their latency, and probe RTT inflation
+            # is the early-warning signal for a congested/flapping edge.
+            t0 = time.perf_counter()
+            up = self.probe(client)
+            if self._metrics is not None:
+                self._metrics.histogram(
+                    "fedtpu_ft_rpc_seconds",
+                    "FT control-plane RPC round-trip seconds by rpc",
+                    labels={"rpc": "HeartBeat"},
+                ).observe(time.perf_counter() - t0)
+            if up:
                 try:
                     self.resync(client)
                 except Exception:

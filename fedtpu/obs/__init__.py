@@ -7,12 +7,21 @@ The observability subsystem the round/transport/FT stack reports into
 - :mod:`fedtpu.obs.trace` — nested spans, Chrome-trace (Perfetto) export,
   jax ``TraceAnnotation`` bridge;
 - :mod:`fedtpu.obs.exporters` — schema-versioned JSONL round records and
-  Prometheus text dumps.
+  Prometheus text dumps;
+- :mod:`fedtpu.obs.propagate` — trace-context propagation over gRPC
+  (``fedtpu-trace-bin`` metadata; merge with ``tools/trace_merge.py``);
+- :mod:`fedtpu.obs.http` — the live ``/metrics`` ``/healthz`` ``/statusz``
+  endpoint (``--obs-port``) + the :class:`StatusBoard` it reads;
+- :mod:`fedtpu.obs.flight` — the crash flight recorder (ring buffer dumped
+  on unhandled exception, SIGUSR1, and failover transitions).
 
-:class:`Telemetry` bundles them behind ``FedConfig.telemetry``
+:class:`Telemetry` bundles tracer+registry behind ``FedConfig.telemetry``
 (``off | basic | trace``). No jax import at module scope — config-only and
 FT users never pay for a backend.
 """
+
+from fedtpu.obs.flight import FlightRecorder
+from fedtpu.obs.http import ObsServer, StatusBoard
 
 from fedtpu.obs.exporters import (
     SCHEMA_VERSION,
@@ -38,6 +47,9 @@ from fedtpu.obs.telemetry import (
 from fedtpu.obs.trace import SpanTracer, load_chrome_trace, write_chrome_trace
 
 __all__ = [
+    "FlightRecorder",
+    "ObsServer",
+    "StatusBoard",
     "SCHEMA_VERSION",
     "RoundRecordWriter",
     "parse_prometheus_text",

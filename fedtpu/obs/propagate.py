@@ -1,0 +1,166 @@
+"""Trace-context propagation over the gRPC edge.
+
+PR 3's tracer made each process's spans self-consistent; this module makes
+them *federation*-consistent. The coordinator attaches a
+``fedtpu-trace-bin`` metadata entry to every outbound RPC (StartTrain,
+SendModel, HeartBeat, CheckIfPrimaryUp, FetchModel) carrying:
+
+- ``trace_id``  — the federation-wide run identity (the coordinator
+  tracer's random id, adopted by every client that sees it);
+- ``span_id``   — the *sender-local* id of the innermost open span on the
+  issuing thread (the ``client_rpc`` span for collect workers, 0 when no
+  span is open, e.g. heartbeat probes);
+- ``role``      — the sender's process identity ("primary", "backup", ...),
+  which is how a receiver's ``remote_parent`` id is resolved to the right
+  per-process trace file at merge time;
+- ``round``     — the coordinator's lineage round counter.
+
+The payload is JSON bytes (gRPC binary metadata — the ``-bin`` suffix is
+mandatory for non-ASCII values): a dozen µs of encode+decode per RPC
+against multi-ms RPCs (measured: ``bench.py --obs-plane-microbench``,
+artifacts/OBS_PLANE_MICROBENCH.json). Injection happens in a client-side
+interceptor whose context *source* is injected, so the transport layer
+never imports server internals; when the source returns ``None`` (telemetry
+below ``trace``) the interceptor forwards the call untouched and costs one
+function call.
+
+Receivers (`fedtpu.transport.service.trace_context_of` →
+``ClientAgent``/``LocalTrainer``) stamp the extracted fields onto their own
+spans as ``trace_id`` / ``remote_parent`` / ``remote_role`` args and adopt
+the trace id — the cross-process link ``tools/trace_merge.py`` stitches on.
+
+No jax import; safe for config-only and tools users.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Optional
+
+METADATA_KEY = "fedtpu-trace-bin"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One RPC's propagated trace coordinates (see module docstring)."""
+
+    trace_id: str
+    span_id: int = 0
+    role: str = ""
+    round: int = 0
+
+
+def encode_context(ctx: TraceContext) -> bytes:
+    return json.dumps(
+        {
+            "trace_id": ctx.trace_id,
+            "span_id": int(ctx.span_id or 0),
+            "role": ctx.role,
+            "round": int(ctx.round),
+        },
+        separators=(",", ":"),
+    ).encode()
+
+
+def decode_context(data: bytes) -> Optional[TraceContext]:
+    """None on any malformed payload — a bad peer must never break an RPC."""
+    try:
+        obj = json.loads(data.decode())
+        return TraceContext(
+            trace_id=str(obj["trace_id"]),
+            span_id=int(obj.get("span_id", 0)),
+            role=str(obj.get("role", "")),
+            round=int(obj.get("round", 0)),
+        )
+    except (ValueError, KeyError, TypeError, AttributeError):
+        return None
+
+
+def from_metadata(metadata) -> Optional[TraceContext]:
+    """Extract the context from gRPC invocation metadata (a sequence of
+    ``(key, value)`` pairs), or None when absent/malformed."""
+    if not metadata:
+        return None
+    for key, value in metadata:
+        if key == METADATA_KEY:
+            if isinstance(value, str):
+                value = value.encode()
+            return decode_context(value)
+    return None
+
+
+def span_args(ctx: Optional[TraceContext]) -> dict:
+    """The receiver-side span args a propagated context contributes:
+    ``trace_id`` (the coordinator's), ``remote_parent`` + ``remote_role``
+    (the cross-process parent link trace_merge resolves), and the
+    coordinator's ``coord_round`` (named so it can never collide with a
+    receiver's own ``round=`` span arg). Empty when no context arrived, so
+    call sites can unconditionally ``**span_args(ctx)``."""
+    if ctx is None:
+        return {}
+    args = {"trace_id": ctx.trace_id, "coord_round": ctx.round}
+    if ctx.span_id:
+        args["remote_parent"] = ctx.span_id
+        args["remote_role"] = ctx.role
+    return args
+
+
+def adopt(tracer, ctx: Optional[TraceContext]) -> None:
+    """Adopt the federation trace id on a receiver's tracer (idempotent;
+    no-op without a tracer or context)."""
+    if tracer is not None and ctx is not None and ctx.trace_id:
+        tracer.trace_id = ctx.trace_id
+
+
+# ------------------------------------------------------------- interceptor
+def _build_interceptor_types():
+    """Interceptor classes are built lazily so this module imports without
+    grpc (config-only users, tools)."""
+    import grpc
+
+    class _CallDetails(
+        # namedtuple-style replacement: grpc requires a ClientCallDetails
+        # instance, attribute-compatible with the one it handed us.
+        grpc.ClientCallDetails
+    ):
+        def __init__(self, base, metadata):
+            self.method = base.method
+            self.timeout = base.timeout
+            self.metadata = metadata
+            self.credentials = getattr(base, "credentials", None)
+            self.wait_for_ready = getattr(base, "wait_for_ready", None)
+            self.compression = getattr(base, "compression", None)
+
+    class TraceContextInterceptor(grpc.UnaryUnaryClientInterceptor):
+        """Appends ``fedtpu-trace-bin`` metadata when the injected source
+        yields a context; forwards untouched otherwise."""
+
+        def __init__(self, source: Callable[[], Optional[TraceContext]]):
+            self._source = source
+
+        def intercept_unary_unary(self, continuation, client_call_details,
+                                  request):
+            try:
+                ctx = self._source()
+            except Exception:
+                ctx = None
+            if ctx is None:
+                return continuation(client_call_details, request)
+            metadata = list(client_call_details.metadata or ())
+            metadata.append((METADATA_KEY, encode_context(ctx)))
+            return continuation(
+                _CallDetails(client_call_details, metadata), request
+            )
+
+    return TraceContextInterceptor
+
+
+def instrument_channel(channel,
+                       source: Callable[[], Optional[TraceContext]]):
+    """Wrap ``channel`` so every unary RPC carries the source's current
+    trace context. ``source`` runs per RPC on the issuing thread (that is
+    what lets the innermost-span id ride along)."""
+    import grpc
+
+    return grpc.intercept_channel(channel, _build_interceptor_types()(source))

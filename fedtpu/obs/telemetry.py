@@ -86,8 +86,14 @@ class Telemetry:
 
     def __init__(self, mode: str = "basic",
                  registry: Optional[MetricsRegistry] = None,
-                 bridge_jax: Optional[bool] = None):
+                 bridge_jax: Optional[bool] = None,
+                 role: Optional[str] = None):
         self.mode = validate_telemetry_mode(mode)
+        # Process/component identity for multi-process trace stitching and
+        # the flight recorder's dump filenames: "primary", "backup",
+        # "client:<addr>", "engine", ... Settable post-construction (the
+        # components that own a Telemetry stamp it).
+        self.role = role
         self.enabled = mode != "off"
         self.tracing = mode == "trace"
         # A registry exists even in off mode (so handing
@@ -112,12 +118,25 @@ class Telemetry:
 
     def export_trace(self, path: str) -> None:
         """Write the collected spans as a Perfetto-loadable Chrome trace.
-        No-op below ``trace`` mode (nothing was collected)."""
+        No-op below ``trace`` mode (nothing was collected). The dump's
+        ``metadata`` block (trace id, role, pid, wall_start) is what
+        ``tools/trace_merge.py`` keys on when stitching per-process files
+        into one federation timeline."""
         if self.tracer is None:
             return
+        import os
+
         from fedtpu.obs.trace import write_chrome_trace
 
-        write_chrome_trace(self.tracer.events(), path)
+        write_chrome_trace(
+            self.tracer.events(), path,
+            metadata={
+                "trace_id": self.tracer.trace_id,
+                "role": self.role or f"pid{os.getpid()}",
+                "pid": os.getpid(),
+                "wall_start": self.tracer.wall_start,
+            },
+        )
 
     # ----------------------------------------------------------- metrics
     def counter(self, name: str, help: str = "", labels=None) -> Counter:

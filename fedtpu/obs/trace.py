@@ -116,6 +116,16 @@ class SpanTracer:
         self._ids = itertools.count(1)
         self._local = threading.local()
         self._pid = os.getpid()
+        # Federation-wide trace identity: random per tracer, OVERWRITTEN on
+        # remote clients the moment a propagated context arrives
+        # (fedtpu.obs.propagate) so every process in one federation run
+        # shares the coordinator's id. Span ids stay process-local;
+        # tools/trace_merge.py qualifies them by role when stitching.
+        self.trace_id: str = os.urandom(8).hex()
+        # Optional per-event hook (e.g. the flight recorder's span feed) —
+        # called with the finished Chrome event OUTSIDE the tracer lock.
+        # Must never raise into the traced code path.
+        self.sink = None
         self._annotation = None
         if bridge_jax:
             try:
@@ -134,6 +144,12 @@ class SpanTracer:
     def _record(self, event: dict) -> None:
         with self._lock:
             self._events.append(event)
+        sink = self.sink
+        if sink is not None:
+            try:
+                sink(event)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ api
     def span(self, name: str, parent: Optional[int] = None,
@@ -159,12 +175,22 @@ class SpanTracer:
             self._events.clear()
 
 
-def write_chrome_trace(events: List[dict], path: str) -> None:
-    """Write events as a Perfetto/chrome://tracing-loadable JSON object."""
+def write_chrome_trace(events: List[dict], path: str,
+                       metadata: Optional[Dict[str, Any]] = None) -> None:
+    """Write events as a Perfetto/chrome://tracing-loadable JSON object.
+
+    ``metadata`` (ignored by viewers, read by ``tools/trace_merge.py``)
+    carries the process identity a multi-process merge needs: the
+    federation ``trace_id``, this process's ``role``/``pid``, and
+    ``wall_start`` — the wall-clock time of the tracer's monotonic zero,
+    which is how per-process relative timestamps align on one timeline.
+    """
     doc = {
         "traceEvents": list(events),
         "displayTimeUnit": "ms",
     }
+    if metadata:
+        doc["metadata"] = dict(metadata)
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(doc, fh)

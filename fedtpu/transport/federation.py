@@ -25,6 +25,7 @@ genuinely separate processes/hosts federating over a network edge.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -47,7 +48,8 @@ from fedtpu.ft import (
     PrimaryPinger,
     WatchdogRunner,
 )
-from fedtpu.obs import Telemetry
+from fedtpu.obs import FlightRecorder, StatusBoard, Telemetry
+from fedtpu.obs import propagate
 from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
 from fedtpu.transport.service import (
@@ -56,6 +58,7 @@ from fedtpu.transport.service import (
     create_channel,
     create_server,
     probe,
+    trace_context_of,
 )
 
 log = logging.getLogger("fedtpu.federation")
@@ -93,7 +96,7 @@ class LocalTrainer:
 
     def __init__(self, cfg: RoundConfig, seed: int = 0):
         self.cfg = cfg
-        self.telemetry = Telemetry(cfg.fed.telemetry)
+        self.telemetry = Telemetry(cfg.fed.telemetry, role="client")
         n_classes = dataset_info(cfg.data.dataset)[1]
         if cfg.num_classes != n_classes:
             raise ValueError(
@@ -124,7 +127,6 @@ class LocalTrainer:
         # the next round's delta (the host-side analogue of
         # fedtpu.ops.compression residuals).
         self.edge_residual = None
-        self.telemetry = Telemetry(cfg.fed.telemetry)
         # Dense f32 wire size of one full model payload — the denominator
         # of the compression-ratio gauge (codec bytes / dense bytes).
         self._dense_bytes = sum(
@@ -155,11 +157,19 @@ class LocalTrainer:
             raise ValueError(f"unknown partition {cfg.data.partition}")
         return idx[rank : rank + 1], mask[rank : rank + 1]
 
-    def train_round(self, rank: int, world: int) -> bytes:
+    def train_round(self, rank: int, world: int,
+                    trace_ctx: Optional[propagate.TraceContext] = None) -> bytes:
         """One local epoch on this client's shard; returns the wire payload
-        (trained weights + stats + example count)."""
+        (trained weights + stats + example count). ``trace_ctx`` — the
+        coordinator's propagated trace context, when the StartTrain carried
+        one: the span below then records the federation ``trace_id`` plus
+        ``remote_parent``/``remote_role`` so ``tools/trace_merge.py`` can
+        nest this client's work under the coordinator's round span, and the
+        tracer adopts the federation trace id."""
         tel = self.telemetry
-        with tel.span("client_train", rank=rank, round=self.round_idx):
+        propagate.adopt(tel.tracer, trace_ctx)
+        with tel.span("client_train", rank=rank, round=self.round_idx,
+                      **propagate.span_args(trace_ctx)):
             payload = self._train_round_impl(rank, world)
         tel.counter(
             "fedtpu_client_tx_bytes_total",
@@ -246,8 +256,11 @@ class LocalTrainer:
         }
         return wire.encode(payload, compress=codec != "none")
 
-    def set_global(self, data: bytes) -> None:
-        with self.telemetry.span("install_global"):
+    def set_global(self, data: bytes,
+                   trace_ctx: Optional[propagate.TraceContext] = None) -> None:
+        propagate.adopt(self.telemetry.tracer, trace_ctx)
+        with self.telemetry.span("install_global",
+                                 **propagate.span_args(trace_ctx)):
             params, stats = _model_template(self.model, self.cfg)
             tree = wire.decode(data, {"params": params, "batch_stats": stats})
             self.params = jax.tree.map(jnp.asarray, tree["params"])
@@ -281,17 +294,35 @@ class ClientAgent(TrainerServicer):
         self.last_eval: Optional[Tuple[float, float]] = None
 
     def StartTrain(self, request: proto.TrainRequest, context) -> proto.TrainReply:
-        payload = self.trainer.train_round(request.rank, request.world)
+        payload = self.trainer.train_round(
+            request.rank, request.world, trace_ctx=trace_context_of(context)
+        )
         return proto.TrainReply(message=payload)
 
     def SendModel(self, request: proto.SendModelRequest, context) -> proto.SendModelReply:
-        self.trainer.set_global(request.model)
+        self.trainer.set_global(
+            request.model, trace_ctx=trace_context_of(context)
+        )
         self.last_eval = self.trainer.evaluate()
         log.info("global model installed: eval %s", self.last_eval)
         return proto.SendModelReply(reply=f"{self.last_eval[1]:.4f}".encode())
 
     def HeartBeat(self, request: proto.Request, context) -> proto.HeartBeatResponse:
         return proto.HeartBeatResponse(status=1)
+
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed for a client agent process."""
+        t = self.trainer
+        return {
+            "role": t.telemetry.role or "client",
+            "pid": os.getpid(),
+            "round": t.round_idx,
+            "synced": t.synced,
+            "last_eval": (
+                {"loss": self.last_eval[0], "acc": self.last_eval[1]}
+                if self.last_eval else None
+            ),
+        }
 
 
 def serve_client(
@@ -300,6 +331,8 @@ def serve_client(
     """Build + start a client agent server on ``address`` (parity:
     ``serve``, ``src/client.py:38-52``). Returns (server, agent)."""
     agent = ClientAgent(cfg, seed=seed)
+    # The bind address doubles as the client's trace/flight identity.
+    agent.trainer.telemetry.role = f"client:{address}"
     server = create_server(address, agent, compress=compress)
     server.start()
     return server, agent
@@ -325,6 +358,7 @@ class PrimaryServer:
         initial_model: Optional[bytes] = None,
         rpc_timeout: float = 600.0,
         round_deadline_s: Optional[float] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         """``round_deadline_s``: straggler mitigation — wait at most this
         long for StartTrain replies each round, then aggregate whatever
@@ -336,7 +370,20 @@ class PrimaryServer:
         self.compress = compress
         self.rpc_timeout = rpc_timeout
         self.round_deadline_s = round_deadline_s
-        self.telemetry = Telemetry(cfg.fed.telemetry)
+        self.telemetry = Telemetry(cfg.fed.telemetry, role="primary")
+        # Flight recorder: bounded black box of recent spans, round marks,
+        # and warning+ events — dumpable at any moment (obs/flight.py). The
+        # CLI passes one with the process hooks armed; library users get a
+        # buffer they can dump by hand / read over /flightz.
+        self.flight = flight if flight is not None else FlightRecorder(
+            role="primary"
+        )
+        if self.telemetry.tracer is not None:
+            self.telemetry.tracer.sink = self.flight.record_span
+        # Live status feed for /statusz (obs/http.py): the round loop
+        # updates round/phase as it moves; status_snapshot() adds the
+        # registry-backed liveness/failure context.
+        self.status = StatusBoard(role="primary", phase="init", round=0)
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
         shape = dataset_info(cfg.data.dataset)[0]
         variables = self.model.init(
@@ -399,11 +446,19 @@ class PrimaryServer:
 
         _metrics = self.telemetry.registry if self.telemetry.enabled else None
         self.registry = ClientRegistry(clients, metrics=_metrics)
+        # Every outbound channel (StartTrain/SendModel fan-out, heartbeat
+        # probes, backup pings/replication/FetchModel) carries the
+        # trace-propagation interceptor; _trace_source yields None below
+        # trace mode, so the interceptor is a single no-op call then.
         self._stubs: Dict[str, TrainerStub] = {
-            c: TrainerStub(create_channel(c, compress=compress)) for c in clients
+            c: TrainerStub(create_channel(
+                c, compress=compress, trace_source=self._trace_source))
+            for c in clients
         }
         self.backup_stub = (
-            TrainerStub(create_channel(backup_address, compress=compress))
+            TrainerStub(create_channel(
+                backup_address, compress=compress,
+                trace_source=self._trace_source))
             if backup_address
             else None
         )
@@ -414,7 +469,8 @@ class PrimaryServer:
             metrics=_metrics,
         )
         self.pinger = (
-            PrimaryPinger(self._ping_backup) if self.backup_stub else None
+            PrimaryPinger(self._ping_backup, metrics=_metrics)
+            if self.backup_stub else None
         )
         self._aggregate = jax.jit(self._aggregate_impl)
         # Streaming collect pipeline (server_pipeline="stream", resolved
@@ -708,6 +764,60 @@ class PrimaryServer:
                 log.warning("backup demoted but FetchModel failed")
         return resp.value
 
+    # ---------------------------------------------------------- observability
+    def _trace_source(self) -> Optional[propagate.TraceContext]:
+        """Per-RPC propagation context (runs on the issuing thread, so the
+        innermost open span — the collect worker's ``client_rpc`` — becomes
+        the remote parent). None below trace mode: the interceptor then
+        forwards the call untouched."""
+        tracer = self.telemetry.tracer
+        if tracer is None:
+            return None
+        return propagate.TraceContext(
+            trace_id=tracer.trace_id,
+            span_id=tracer.current_id() or 0,
+            role=self.telemetry.role or "primary",
+            round=self._round_counter,
+        )
+
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed: live round/phase (from the round loop's
+        :class:`StatusBoard` updates) + client liveness + FT counters +
+        the last round record's phase timings."""
+        snap = self.status.snapshot()
+        reg = self.registry
+        snap.update(
+            pid=os.getpid(),
+            clients={
+                "alive": reg.active_clients(),
+                "dead": reg.dead_clients(),
+            },
+            stragglers_in_flight=sorted(
+                c for c, t in self._inflight.items() if t.is_alive()
+            ),
+            rounds_completed=len(self.history),
+        )
+        tel = self.telemetry
+        if tel.enabled:
+            snap["heartbeat_misses"] = tel.registry.counter(
+                "fedtpu_ft_heartbeat_misses_total",
+                "heartbeat probes of dead clients that stayed dead",
+            ).value
+        if tel.tracer is not None:
+            snap["trace_id"] = tel.tracer.trace_id
+        if self.history:
+            last = self.history[-1]
+            snap["last_round"] = {
+                k: last[k]
+                for k in (
+                    "participants", "stragglers", "bytes_up", "bytes_down",
+                    "t_collect_s", "t_decode_s", "t_h2d_s", "t_aggregate_s",
+                    "t_post_barrier_s", "pipeline",
+                )
+                if k in last
+            }
+        return snap
+
     # ------------------------------------------------------------ round loop
     def round(self) -> dict:
         """One synchronous FedAvg round; returns the round record.
@@ -719,6 +829,15 @@ class PrimaryServer:
         tel = self.telemetry
         with tel.span("round", round=self._round_counter) as rspan:
             rec = self._round_body(rspan)
+        self.status.update(phase="idle")
+        self.flight.record(
+            "round",
+            round=self._round_counter - 1,
+            participants=rec["participants"],
+            stragglers=rec["stragglers"],
+            t_collect_s=rec["t_collect_s"],
+            t_aggregate_s=rec["t_aggregate_s"],
+        )
         if tel.enabled:
             tel.counter(
                 "fedtpu_rounds_completed_total",
@@ -747,6 +866,7 @@ class PrimaryServer:
     def _round_body(self, rspan) -> dict:
         cfg = self.cfg
         tel = self.telemetry
+        self.status.update(round=self._round_counter, phase="collect")
         if not self._did_initial_sync:
             self.sync_clients()
         active = self.registry.active_clients()
@@ -1014,6 +1134,7 @@ class PrimaryServer:
             for c in active
             if c in results and c not in stragglers
         }
+        self.status.update(phase="aggregate")
         if completed:
             with tel.span("aggregate", participants=len(completed)):
                 order = [c for c in active if c in completed]
@@ -1075,6 +1196,7 @@ class PrimaryServer:
         # this round's DP noise key against a different aggregate.
         self._round_counter += 1
 
+        self.status.update(phase="broadcast")
         payload = self.model_bytes()
         # Backup first (parity: replication before client broadcast,
         # src/server.py:141-153). The backup gets the replica payload —
@@ -1413,6 +1535,15 @@ class PrimaryServer:
                     "alive": self.registry.alive_mask().tolist(),
                 }
                 self.history.append(rec)
+                self.status.update(
+                    round=self._round_counter, phase="async",
+                    async_update=self._async_version,
+                )
+                self.flight.record(
+                    "async_update",
+                    update=self._async_version,
+                    contributors=len(buf),
+                )
                 if tel.enabled:
                     tel.counter(
                         "fedtpu_async_updates_total",
@@ -1495,6 +1626,7 @@ class BackupServer(TrainerServicer):
         compress: bool = False,
         watchdog_timeout: float = 10.0,
         round_deadline_s: Optional[float] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         self.cfg = cfg
         self.clients = clients
@@ -1504,7 +1636,13 @@ class BackupServer(TrainerServicer):
         self.round_deadline_s = round_deadline_s
         self.latest_model: Optional[bytes] = None
         self.acting: Optional[PrimaryServer] = None
-        self.telemetry = Telemetry(cfg.fed.telemetry)
+        self.telemetry = Telemetry(cfg.fed.telemetry, role="backup")
+        # The black box this module exists for: the state machine dumps it
+        # on EVERY promote/demote, so the run-up to a role flip survives
+        # even if the promoted process dies seconds later.
+        self.flight = flight if flight is not None else FlightRecorder(
+            role="backup"
+        )
         self.machine = FailoverStateMachine(
             timeout=watchdog_timeout,
             on_promote=self._promote,
@@ -1512,6 +1650,7 @@ class BackupServer(TrainerServicer):
             metrics=(
                 self.telemetry.registry if self.telemetry.enabled else None
             ),
+            flight=self.flight,
         )
         self.watchdog = WatchdogRunner(self.machine)
         # Per-promotion stop event: a primary flap must not re-arm a stopped
@@ -1543,6 +1682,26 @@ class BackupServer(TrainerServicer):
             return proto.SendModelRequest(model=acting.replica_bytes())
         return proto.SendModelRequest(model=self.latest_model or b"")
 
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed for the backup role: failover state + (when
+        promoted) the acting primary's own status nested under
+        ``acting``."""
+        machine = self.machine
+        since = machine.seconds_since_ping()
+        snap = {
+            "role": machine.role.value,
+            "pid": os.getpid(),
+            "watchdog_timeout_s": machine.timeout,
+            "seconds_since_primary_ping": (
+                None if since == float("inf") else round(since, 3)
+            ),
+            "has_replica": self.latest_model is not None,
+        }
+        acting = self.acting
+        if acting is not None and machine.role.value == "acting_primary":
+            snap["acting"] = acting.status_snapshot()
+        return snap
+
     # -------------------------------------------------------------- failover
     def _promote(self) -> None:
         log.warning("watchdog expired: promoting to acting primary")
@@ -1556,6 +1715,7 @@ class BackupServer(TrainerServicer):
                 compress=self.compress,
                 initial_model=self.latest_model,
                 round_deadline_s=self.round_deadline_s,
+                flight=self.flight,
             )
         except wire.WireError:
             # A corrupted replica must fail loudly — but not by silently
@@ -1571,6 +1731,7 @@ class BackupServer(TrainerServicer):
                 self.clients,
                 compress=self.compress,
                 round_deadline_s=self.round_deadline_s,
+                flight=self.flight,
             )
         self.acting = acting
 

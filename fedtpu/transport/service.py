@@ -105,13 +105,34 @@ def add_trainer_servicer(servicer: TrainerServicer, server: grpc.Server) -> None
     )
 
 
-def create_channel(address: str, compress: bool = False) -> grpc.Channel:
+def trace_context_of(context):
+    """Extract the propagated ``fedtpu-trace-bin`` trace context from a
+    servicer's handler context (None when the caller attached none or the
+    payload is malformed — extraction must never fail an RPC). The
+    injection side is :func:`fedtpu.obs.propagate.instrument_channel`."""
+    from fedtpu.obs import propagate
+
+    try:
+        return propagate.from_metadata(context.invocation_metadata())
+    except Exception:
+        return None
+
+
+def create_channel(address: str, compress: bool = False,
+                   trace_source=None) -> grpc.Channel:
     """Insecure channel with 1 GiB caps and optional gzip (parity:
-    ``createChannel``, ``src/server.py:103-107``)."""
+    ``createChannel``, ``src/server.py:103-107``). ``trace_source`` (a
+    ``() -> Optional[TraceContext]``) wraps the channel with the
+    trace-propagation interceptor; None keeps the plain channel."""
     kwargs = {}
     if compress:
         kwargs["compression"] = grpc.Compression.Gzip
-    return grpc.insecure_channel(address, options=_CHANNEL_OPTIONS, **kwargs)
+    channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS, **kwargs)
+    if trace_source is not None:
+        from fedtpu.obs import propagate
+
+        channel = propagate.instrument_channel(channel, trace_source)
+    return channel
 
 
 def create_server(

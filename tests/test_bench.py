@@ -233,6 +233,40 @@ def test_bench_model_wrapper_smoke(tmp_path, monkeypatch):
             pass
 
 
+def test_obs_plane_microbench_contract(bench, monkeypatch, tmp_path):
+    """--obs-plane-microbench at a seconds-scale config: schema + artifact
+    emission (the <=1%-on-densenet acceptance gate itself is pinned by the
+    committed artifacts/OBS_PLANE_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_OB_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_OB_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_OB_REPS", "2")
+    result = bench._obs_plane_microbench()
+    assert result["metric"] == "obs_plane_overhead"
+    assert result["value"] > 0
+    assert result["per_rpc_us"]["inject"] > 0
+    assert result["per_rpc_us"]["extract"] > 0
+    assert result["per_round_status_us"] > 0
+    # The attributable arithmetic is auditable from its own parts.
+    clients = result["num_clients"]
+    per_round = clients * (
+        result["per_rpc_us"]["inject"] + result["per_rpc_us"]["extract"]
+    ) + result["per_round_status_us"]
+    assert result["per_round_obs_us"] == pytest.approx(per_round, rel=1e-3)
+    assert result["gate_pct"] == 1.0
+    assert isinstance(result["passes_gate"], bool)
+    assert result["noise_floor_pct"] >= 0
+    assert set(result["round_ms"]) == {"bare", "obs"}
+    assert all(v > 0 for v in result["round_ms"].values())
+    path = os.path.join(str(art), "OBS_PLANE_MICROBENCH.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
 def test_telemetry_microbench_contract(bench, monkeypatch, tmp_path):
     """--telemetry-microbench at a seconds-scale config: schema, artifact
     emission, and a valid trace-check leg (the <1%-on-densenet acceptance

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Span-name drift check: every span the framework emits must be documented.
+
+Scans ``fedtpu/`` for literal span names passed to ``*.span("name", ...)``
+and verifies each appears as inline code (`` `name` ``) in
+``docs/OBSERVABILITY.md``'s span table. Catches the silent failure mode
+where a new subsystem adds spans (or renames one) and the operator-facing
+span model drifts out of date — dashboards and trace queries then filter
+on names that no longer exist.
+
+Tier-1 runnable: ``tests/test_obs_propagation.py`` calls :func:`check`;
+standalone: ``python tools/span_check.py`` (exit 1 + a list on drift).
+Stdlib only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Literal first argument of a .span( call. Variables/f-strings never match
+# — fedtpu's span names are deliberately all literal (greppability is the
+# point of a fixed span vocabulary).
+_SPAN_CALL = re.compile(r"""\.span\(\s*(['"])([A-Za-z0-9_.:-]+)\1""")
+_INLINE_CODE = re.compile(r"`([^`]+)`")
+
+
+def emitted_span_names(package_dir: str = None) -> Dict[str, List[str]]:
+    """{span name: [relative file paths emitting it]} over fedtpu/."""
+    package_dir = package_dir or os.path.join(REPO, "fedtpu")
+    found: Dict[str, List[str]] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            for m in _SPAN_CALL.finditer(text):
+                rel = os.path.relpath(path, REPO)
+                found.setdefault(m.group(2), []).append(rel)
+    return found
+
+
+def documented_names(doc_path: str = None) -> Set[str]:
+    """Every inline-code token in OBSERVABILITY.md (the span table uses
+    `` `name` `` markup; matching the whole doc keeps the check insensitive
+    to table layout)."""
+    doc_path = doc_path or os.path.join(REPO, "docs", "OBSERVABILITY.md")
+    with open(doc_path, encoding="utf-8") as fh:
+        text = fh.read()
+    # Drop fenced code blocks first: their ``` markers desynchronize naive
+    # single-backtick pairing over the rest of the document.
+    text = re.sub(r"```.*?```", "", text, flags=re.S)
+    names: Set[str] = set()
+    for m in _INLINE_CODE.finditer(text):
+        # A cell like `round` / `fused_rounds` documents both tokens.
+        for tok in re.split(r"[\s/|,]+", m.group(1)):
+            if tok:
+                names.add(tok.strip())
+    return names
+
+
+def check(package_dir: str = None, doc_path: str = None) -> List[str]:
+    """Problem strings (empty = pass)."""
+    emitted = emitted_span_names(package_dir)
+    documented = documented_names(doc_path)
+    problems = []
+    if not emitted:
+        problems.append("scanner found NO span calls in fedtpu/ — the "
+                        "regex or layout drifted; fix tools/span_check.py")
+    for name in sorted(emitted):
+        if name not in documented:
+            problems.append(
+                f"span {name!r} (emitted in {', '.join(emitted[name])}) has "
+                "no entry in docs/OBSERVABILITY.md"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = check()
+    if problems:
+        for problem in problems:
+            print(f"SPAN DRIFT: {problem}", file=sys.stderr)
+        return 1
+    n = len(emitted_span_names())
+    print(f"ok: {n} span names emitted, all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

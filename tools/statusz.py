@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Live one-line-per-round view of a federation's ``/statusz`` endpoint.
+
+Point it at a process started with ``--obs-port`` (server/run/train CLIs):
+
+    python tools/statusz.py http://127.0.0.1:8790            # one line now
+    python tools/statusz.py http://127.0.0.1:8790 --watch    # line per round
+
+``--watch`` polls every ``--interval`` seconds and prints a fresh line
+whenever the round (or failover role) advances — the terminal-native
+replacement for staring at a JSONL tail. Stdlib only, no fedtpu import
+(usable against a remote host from a machine without the repo).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 2.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/statusz",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def render_line(status: dict) -> str:
+    """One compact line from a /statusz snapshot (any role's shape)."""
+    # A promoted backup nests the acting primary's status; show that one,
+    # prefixed with the outer role.
+    prefix = ""
+    if "acting" in status and isinstance(status["acting"], dict):
+        prefix = f"[{status.get('role', '?')}] "
+        status = status["acting"]
+    parts = [f"{prefix}role={status.get('role', '?')}"]
+    if "round" in status:
+        parts.append(f"round={status['round']}")
+    if "phase" in status:
+        parts.append(f"phase={status['phase']}")
+    clients = status.get("clients")
+    if isinstance(clients, dict):
+        alive = clients.get("alive", [])
+        dead = clients.get("dead", [])
+        parts.append(f"alive={len(alive)}/{len(alive) + len(dead)}")
+        if dead:
+            parts.append(f"dead={','.join(dead)}")
+    elif isinstance(status.get("alive"), list):
+        mask = status["alive"]
+        parts.append(f"alive={sum(1 for a in mask if a)}/{len(mask)}")
+    if status.get("heartbeat_misses"):
+        parts.append(f"hb_miss={int(status['heartbeat_misses'])}")
+    if status.get("seconds_since_primary_ping") is not None:
+        parts.append(f"ping_age={status['seconds_since_primary_ping']:.1f}s")
+    last = status.get("last_round")
+    if isinstance(last, dict):
+        timing = " ".join(
+            f"{k[2:-2]}={last[k]:.3f}s"
+            for k in ("t_collect_s", "t_aggregate_s")
+            if isinstance(last.get(k), (int, float))
+        )
+        extras = []
+        if "participants" in last:
+            extras.append(f"part={last['participants']}")
+        if last.get("stragglers"):
+            extras.append(f"strag={last['stragglers']}")
+        parts.append(("last[" + " ".join(extras + [timing]).strip() + "]"))
+    return " ".join(parts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("url", help="base obs URL, e.g. http://127.0.0.1:8790")
+    p.add_argument("--watch", action="store_true",
+                   help="poll until interrupted; print a line whenever the "
+                   "round or role changes")
+    p.add_argument("--interval", default=1.0, type=float,
+                   help="--watch poll period in seconds")
+    p.add_argument("--timeout", default=2.0, type=float)
+    args = p.parse_args(argv)
+
+    last_key = None
+    while True:
+        try:
+            status = fetch(args.url, timeout=args.timeout)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"unreachable: {exc}", file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.interval)
+            continue
+        inner = status.get("acting") or status
+        key = (inner.get("round"), status.get("role"), inner.get("role"))
+        if not args.watch:
+            print(render_line(status))
+            return 0
+        if key != last_key:
+            print(render_line(status), flush=True)
+            last_key = key
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except KeyboardInterrupt:
+        raise SystemExit(130)

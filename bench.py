@@ -933,6 +933,126 @@ def _obs_plane_microbench():
     return result
 
 
+def _chaos_overhead_microbench():
+    """``chaos_overhead``: what an ARMED-but-quiet fault-injection schedule
+    costs per round — the per-RPC ``FaultSchedule.decide`` consult the
+    chaos interceptors add to every outbound call even when no rule fires
+    (rules with ``p=0`` or non-matching RPCs). This is the no-op path the
+    acceptance gate cares about: a chaos layer you can leave compiled into
+    the binary must be free when idle.
+
+    Same two-measurement methodology as ``--obs-plane-microbench``:
+
+    - **Attributable cost** (the headline ``value``): the exact per-RPC
+      consult — one armed schedule with a never-firing rule and a
+      non-matching rule, decided once per client RPC (StartTrain +
+      SendModel per client per round) — timed directly in a tight loop and
+      scaled by the bare round wall of a densenet_cifar CPU round.
+      Acceptance gate: <= 1% (``gate_pct`` / ``passes_gate``).
+    - **A/B walls (audit)**: the same compiled engine driven with and
+      without the per-round consult sequence bolted on, mode order rotated
+      per rep, medians next to the bare trials' spread
+      (``noise_floor_pct``).
+
+    Run via ``python bench.py --chaos-overhead-microbench``; prints one
+    JSON line and writes ``artifacts/CHAOS_OVERHEAD_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.ft.chaos import parse_spec
+
+    model_name = os.environ.get("FEDTPU_CH_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_CH_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_CH_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_CH_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_CH_BATCH", "8"))
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=10,
+        data=DataConfig(
+            dataset="cifar10", batch_size=batch, partition="iid",
+            num_examples=clients * batch * 4,
+        ),
+        fed=FedConfig(num_clients=clients, telemetry="off"),
+        steps_per_round=1,
+    )
+    fed = Federation(cfg, seed=0)
+
+    # Armed but quiet: one rule that can match but never fires (p=0) and
+    # one keyed to an RPC the consult below never asks about — the
+    # worst-case no-op consult (both rules walked per call).
+    schedule = parse_spec("error@StartTrain:p=0.0,seed=7;delay@FetchModel:p=1.0")
+
+    def chaos_round_sequence(r: int) -> None:
+        schedule.set_round(r)
+        for i in range(clients):
+            schedule.decide("StartTrain", f"localhost:5005{i}")
+            schedule.decide("SendModel", f"localhost:5005{i}")
+
+    def run_block(with_chaos: bool):
+        for r in range(rounds):
+            if with_chaos:
+                chaos_round_sequence(r)
+            m = fed.step()
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+
+    run_block(False)  # compile + warmup
+    modes = ("bare", "chaos")
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        for mode in modes if rep % 2 == 0 else modes[::-1]:
+            t0 = time.perf_counter()
+            run_block(mode == "chaos")
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = (med["chaos"] - med["bare"]) / med["bare"] * 100.0
+    noise_floor_pct = (
+        (max(trials["bare"]) - min(trials["bare"])) / med["bare"] * 100.0
+    )
+
+    # Attributable cost: direct timing of the exact per-RPC consult.
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        schedule.decide("StartTrain", "localhost:50051")
+    decide_us = (time.perf_counter() - t0) / n * 1e6
+    per_round_us = clients * 2 * decide_us  # StartTrain + SendModel each
+    attributable_pct = per_round_us / (med["bare"] * 1e6) * 100.0
+
+    result = {
+        "metric": "chaos_overhead",
+        "unit": "% of round wall time attributable to the armed no-op "
+                "fault-injection consult",
+        "value": round(attributable_pct, 6),
+        "gate_pct": 1.0,
+        "passes_gate": bool(attributable_pct <= 1.0),
+        "per_rpc_us": {"decide": round(decide_us, 3)},
+        "per_round_chaos_us": round(per_round_us, 3),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "CHAOS_OVERHEAD_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
@@ -1045,6 +1165,9 @@ def main():
         return
     if "--obs-plane-microbench" in sys.argv:
         print(json.dumps(_obs_plane_microbench()))
+        return
+    if "--chaos-overhead-microbench" in sys.argv:
+        print(json.dumps(_chaos_overhead_microbench()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

@@ -17,11 +17,13 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_robustness_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     compress_enabled,
     install_final_flush,
+    make_chaos,
     make_flight_recorder,
     start_obs_server,
 )
@@ -44,6 +46,7 @@ def main(argv=None) -> int:
     )
     add_telemetry_export_flags(p)
     add_obs_flags(p)
+    add_robustness_flags(p)
     p.add_argument("-a", "--address", default="localhost:50051",
                    help="bind address (doubles as the client's identity)")
     p.add_argument("--world", default=2, type=int,
@@ -57,7 +60,8 @@ def main(argv=None) -> int:
     )
     cfg = build_config(args, num_clients=args.world)
     server, agent = serve_client(
-        args.address, cfg, seed=args.seed, compress=compress_enabled(args)
+        args.address, cfg, seed=args.seed, compress=compress_enabled(args),
+        chaos=make_chaos(args, role=f"client-{args.address}"),
     )
     # A client agent exits via signal (it serves until terminated), so the
     # exporters ONLY fire through the SIGTERM/atexit flush.

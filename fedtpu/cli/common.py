@@ -13,7 +13,13 @@ from __future__ import annotations
 
 import argparse
 
-from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RetryPolicy,
+    RoundConfig,
+)
 from fedtpu.data import dataset_info
 
 
@@ -219,6 +225,143 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_robustness_flags(p: argparse.ArgumentParser) -> None:
+    """Transient-fault resilience + chaos surface (docs/FAULT_TOLERANCE.md),
+    shared by all four CLIs. The retry/quorum flags configure the typed
+    ``RetryPolicy`` / ``round_quorum`` in FedConfig; ``--chaos-spec`` arms
+    the deterministic fault-injection schedule (fedtpu.ft.chaos)."""
+    p.add_argument(
+        "--chaos-spec",
+        default=None,
+        metavar="SPEC",
+        help="arm deterministic fault injection: JSON "
+        '({"seed":7,"rules":[{"kind":"error","rpc":"StartTrain","p":0.3}]}) '
+        "or mini-DSL 'kind@rpc:p=0.3,seed=7' with rules joined by ';'. "
+        "Kinds: delay|drop|error|corrupt|kill; options p, peer, delay "
+        "(seconds), code, rounds=lo-hi, max, seed. Applied via gRPC "
+        "interceptors on the server/client CLIs; the RPC-less run/train "
+        "CLIs honor delay/kill rules on the pseudo-RPC 'Round'. Every "
+        "injection is counted (fedtpu_chaos_injected_total) and flight-"
+        "recorded; same spec + seed = same faults (tools/chaos_soak.py)",
+    )
+    p.add_argument(
+        "--rpc-retries",
+        default=RetryPolicy.max_attempts,
+        type=int,
+        metavar="N",
+        help="total attempts per RPC before the failure is treated as "
+        "real (mark_failed); 1 = the old single-shot behavior. Transient "
+        "status codes (UNAVAILABLE, DEADLINE_EXCEEDED, ...) and corrupt "
+        "payloads (wire CRC) retry; fatal codes never do",
+    )
+    p.add_argument(
+        "--rpc-backoff",
+        default=RetryPolicy.backoff_s,
+        type=float,
+        metavar="SECONDS",
+        help="initial retry backoff; doubles per attempt (jittered, "
+        f"capped at {RetryPolicy.backoff_max_s:.1f}s)",
+    )
+    p.add_argument(
+        "--rpc-timeout",
+        default=None,
+        type=float,
+        metavar="SECONDS",
+        help="deadline for the data-plane RPCs (StartTrain / SendModel / "
+        "FetchModel). Default: the RetryPolicy per-RPC deadlines (600s, "
+        "the old hardcoded constant)",
+    )
+    p.add_argument(
+        "--round-quorum",
+        default=0.0,
+        type=float,
+        metavar="FRACTION",
+        help="minimum fraction of the round's sampled clients that must "
+        "deliver updates for the round to commit; below it the round "
+        "aborts with the global model untouched and re-runs. 0 (default) "
+        "= aggregate whatever arrived (old behavior)",
+    )
+    p.add_argument(
+        "--backup-ping-timeout",
+        default=RetryPolicy.backup_ping_timeout_s,
+        type=float,
+        metavar="SECONDS",
+        help="deadline of the primary's CheckIfPrimaryUp backup ping "
+        "(was hardcoded 2.0s)",
+    )
+    p.add_argument(
+        "--heartbeat-period",
+        default=FedConfig.ft_heartbeat_period_s,
+        type=float,
+        metavar="SECONDS",
+        help="dead-client re-probe period of the heartbeat monitor "
+        "(was hardcoded 1.0s)",
+    )
+    p.add_argument(
+        "--async-poll",
+        default=FedConfig.async_poll_s,
+        type=float,
+        metavar="SECONDS",
+        help="reply-queue poll timeout of the async (FedBuff) server loop "
+        "(was hardcoded 1.0s)",
+    )
+
+
+def robustness_config(args) -> dict:
+    """FedConfig kwargs from the robustness flags (defaults when a CLI
+    doesn't expose them)."""
+    rpc_timeout = getattr(args, "rpc_timeout", None)
+    base = RetryPolicy()
+    retry = RetryPolicy(
+        max_attempts=getattr(args, "rpc_retries", base.max_attempts),
+        backoff_s=getattr(args, "rpc_backoff", base.backoff_s),
+        start_train_timeout_s=(
+            rpc_timeout if rpc_timeout is not None
+            else base.start_train_timeout_s
+        ),
+        send_model_timeout_s=(
+            rpc_timeout if rpc_timeout is not None
+            else base.send_model_timeout_s
+        ),
+        fetch_model_timeout_s=(
+            rpc_timeout if rpc_timeout is not None
+            else base.fetch_model_timeout_s
+        ),
+        backup_ping_timeout_s=getattr(
+            args, "backup_ping_timeout", base.backup_ping_timeout_s
+        ),
+    )
+    return {
+        "retry": retry,
+        "round_quorum": getattr(args, "round_quorum", 0.0),
+        "ft_watchdog_timeout_s": (
+            getattr(args, "watchdog_timeout", None)
+            or FedConfig.ft_watchdog_timeout_s
+        ),
+        "ft_heartbeat_period_s": getattr(
+            args, "heartbeat_period", FedConfig.ft_heartbeat_period_s
+        ),
+        "async_poll_s": getattr(args, "async_poll", FedConfig.async_poll_s),
+    }
+
+
+def make_chaos(args, role: str = ""):
+    """Honor --chaos-spec: parse + arm a FaultSchedule (None when absent).
+    The armed rules are logged so a soak's transcript names its faults."""
+    import logging
+
+    spec = getattr(args, "chaos_spec", None)
+    if not spec:
+        return None
+    from fedtpu.ft import parse_chaos_spec
+
+    chaos = parse_chaos_spec(spec)
+    logging.warning(
+        "CHAOS ARMED%s: %s", f" ({role})" if role else "", chaos.describe()
+    )
+    return chaos
+
+
 def add_telemetry_export_flags(p: argparse.ArgumentParser) -> None:
     """End-of-run exporter paths, shared by the run and server CLIs (the
     per-round JSONL exporter is the existing ``--metrics`` flag)."""
@@ -406,6 +549,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
                 args, "participation_sampling", "uniform"
             ),
             telemetry=getattr(args, "telemetry", "basic"),
+            **robustness_config(args),
         ),
         steps_per_round=steps_per_round,
         debug_per_batch=getattr(args, "debug_per_batch", False),

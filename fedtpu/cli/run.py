@@ -20,10 +20,12 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_robustness_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     install_final_flush,
+    make_chaos,
     make_flight_recorder,
     start_obs_server,
 )
@@ -92,6 +94,7 @@ def main(argv=None) -> int:
     )
     add_telemetry_export_flags(p)
     add_obs_flags(p)
+    add_robustness_flags(p)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
     p.add_argument("-r", "--resume", action="store_true")
@@ -138,10 +141,15 @@ def main(argv=None) -> int:
     bar = (
         ProgressBar(cfg.fed.num_rounds - start_round) if args.progress else None
     )
+    # The simulated engine has no RPC edge; chaos here means crash/latency
+    # drills — delay/kill rules on the pseudo-RPC "Round", once per block.
+    chaos = make_chaos(args, role="engine")
     t0 = time.time()
     with profile_rounds(args.profile_dir):
         r = start_round
         while r < cfg.fed.num_rounds:
+            if chaos is not None:
+                chaos.tick_round(r)
             block = min(max(1, args.fused), cfg.fed.num_rounds - r)
             if block > 1:
                 stacked = fed.run_on_device(block)
@@ -294,8 +302,11 @@ def _run_async(args, cfg) -> int:
 def _async_loop(args, fed, logger, eval_data, ckpt=None, start_tick=0) -> None:
     # Same resume semantics as the sync loop: --async-updates is the TOTAL
     # update count, a resumed run finishes the remainder.
+    chaos = make_chaos(args, role="async_engine")
     t = start_tick
     while t < args.async_updates:
+        if chaos is not None:
+            chaos.tick_round(t)
         block = min(max(1, args.fused), args.async_updates - t)
         if block > 1:
             m = fed.run_on_device(block)

@@ -22,11 +22,13 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_robustness_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     compress_enabled,
     install_final_flush,
+    make_chaos,
     make_flight_recorder,
     start_obs_server,
 )
@@ -59,9 +61,14 @@ def main(argv=None) -> int:
     )
     add_telemetry_export_flags(p)
     add_obs_flags(p)
+    add_robustness_flags(p)
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
-    p.add_argument("--watchdog-timeout", default=10.0, type=float)
+    p.add_argument(
+        "--watchdog-timeout", default=None, type=float,
+        help="backup promotion watchdog window (seconds; default "
+        "FedConfig.ft_watchdog_timeout_s = 10.0)",
+    )
     p.add_argument(
         "--async-updates",
         default=0,
@@ -111,6 +118,7 @@ def main(argv=None) -> int:
             compress=compress,
             round_deadline_s=args.round_deadline,
             flight=flight,
+            chaos=make_chaos(args, role="primary"),
         )
         ckpt = None
         start_round = 0
@@ -163,7 +171,9 @@ def main(argv=None) -> int:
         def on_round(r: int, rec: dict) -> None:
             if metrics is not None:
                 metrics.log(start_round + r, **rec)
-            if ckpt is not None:
+            # No checkpoint on a sub-quorum abort: the state is unchanged
+            # by construction, and the save would just churn the dir.
+            if ckpt is not None and not rec.get("aborted"):
                 ckpt.save(start_round + r, primary.state_tree())
 
         # run() (not a bare round() loop) so the heartbeat recovery thread
@@ -194,6 +204,7 @@ def main(argv=None) -> int:
         watchdog_timeout=args.watchdog_timeout,
         round_deadline_s=args.round_deadline,
         flight=flight,
+        chaos=make_chaos(args, role="backup"),
     )
     server = backup.start(args.listen)
     obs = start_obs_server(

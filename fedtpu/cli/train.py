@@ -17,8 +17,10 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_robustness_flags,
     apply_platform_flag,
     build_config,
+    make_chaos,
     make_flight_recorder,
     start_obs_server,
 )
@@ -31,6 +33,7 @@ def main(argv=None) -> int:
     add_platform_flag(p)
     add_model_flags(p)
     add_obs_flags(p)
+    add_robustness_flags(p)
     p.add_argument("--epochs", default=200, type=int,
                    help="training epochs (reference default: 200)")
     p.add_argument("--checkpoint", default="./checkpoint/solo.fckpt",
@@ -67,9 +70,15 @@ def main(argv=None) -> int:
     status = StatusBoard(role="solo", phase="train", round=0)
     flight = make_flight_recorder("solo")
     obs = start_obs_server(args, status_fn=status.snapshot, flight=flight)
+    # Solo has no RPC edge either: chaos delay/kill rules fire once per
+    # epoch via the per-epoch logger hook (crash-recovery drills for the
+    # best-accuracy checkpoint path).
+    chaos = make_chaos(args, role="solo")
 
     class _StatusLogger(RoundRecordWriter):
         def log(self, step: int, **fields) -> None:
+            if chaos is not None:
+                chaos.tick_round(step)
             status.update(
                 round=step,
                 **{k: v for k, v in fields.items()

@@ -14,6 +14,74 @@ from typing import Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Transient-fault handling for every RPC the federation issues.
+
+    The pre-policy transport treated each RPC as one shot: a single
+    transient ``grpc.RpcError`` (a TCP reset, a brief listener restart, an
+    overloaded peer) marked the client dead for the round and handed it to
+    the heartbeat/resync machinery — the failure path the paper reserves
+    for *real* failures. Under this policy an RPC whose status code is in
+    ``transient_codes`` (or whose reply payload fails the wire CRC — see
+    :mod:`fedtpu.transport.wire`) is retried with exponential backoff +
+    jitter up to ``max_attempts`` total attempts; only EXHAUSTED retries
+    reach ``ClientRegistry.mark_failed``. Fatal codes (UNIMPLEMENTED,
+    INVALID_ARGUMENT, ...) never retry — a config-mismatched peer must
+    fail loudly, not thrash.
+
+    Per-RPC deadlines live here too, replacing the constants that were
+    scattered through the transport (StartTrain/SendModel 600 s at the old
+    ``PrimaryServer(rpc_timeout=...)`` default, backup ping 2.0 s,
+    heartbeat probe 1.0 s). Defaults reproduce the old values exactly, so
+    a default-config federation behaves bit-identically in the absence of
+    faults (retries only ever run where the old code failed).
+    """
+
+    # Total attempts per logical RPC (1 = the old single-shot behavior).
+    max_attempts: int = 3
+    backoff_s: float = 0.05          # sleep before attempt 2
+    backoff_multiplier: float = 2.0  # growth per further attempt
+    backoff_max_s: float = 2.0
+    # Fraction of each backoff randomized (decorrelates retry storms;
+    # irrelevant to determinism — fault *injection* is seeded, not retry
+    # spacing).
+    jitter: float = 0.2
+    # grpc.StatusCode names treated as transient (retryable). Everything
+    # else — UNIMPLEMENTED, INVALID_ARGUMENT, FAILED_PRECONDITION, ... —
+    # is fatal and fails the call on the first attempt.
+    transient_codes: Tuple[str, ...] = (
+        "UNAVAILABLE",
+        "DEADLINE_EXCEEDED",
+        "RESOURCE_EXHAUSTED",
+        "ABORTED",
+        "INTERNAL",
+        "UNKNOWN",
+    )
+    # Per-RPC deadlines (seconds). The data-plane deadlines default to the
+    # old blanket rpc_timeout=600.0; the control-plane ones to the old
+    # hardcoded constants they replace.
+    start_train_timeout_s: float = 600.0
+    send_model_timeout_s: float = 600.0
+    fetch_model_timeout_s: float = 600.0
+    probe_timeout_s: float = 1.0        # HeartBeat (was probe() default)
+    backup_ping_timeout_s: float = 2.0  # CheckIfPrimaryUp (was literal 2.0)
+
+
+def validate_retry_policy(rp: RetryPolicy) -> RetryPolicy:
+    if rp.max_attempts < 1:
+        raise ValueError(f"retry max_attempts must be >= 1, got {rp.max_attempts}")
+    if rp.backoff_s < 0 or rp.backoff_max_s < 0:
+        raise ValueError("retry backoff seconds must be >= 0")
+    if rp.backoff_multiplier < 1.0:
+        raise ValueError(
+            f"retry backoff_multiplier must be >= 1, got {rp.backoff_multiplier}"
+        )
+    if not 0.0 <= rp.jitter <= 1.0:
+        raise ValueError(f"retry jitter must be in [0, 1], got {rp.jitter}")
+    return rp
+
+
+@dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
     """Per-client local optimizer.
 
@@ -190,6 +258,23 @@ class FedConfig:
     #     bridged to jax.profiler.TraceAnnotation so XLA device activity
     #     nests under framework spans when a profiler session is active.
     telemetry: str = "basic"  # off | basic | trace
+    # Transient-fault handling on the gRPC edge: retry/backoff + per-RPC
+    # deadlines (see RetryPolicy). Defaults reproduce the old constants;
+    # the engine (simulated) path has no RPC edge and ignores this.
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    # Minimum fraction of this round's SAMPLED clients that must deliver
+    # updates for the round to commit. Below quorum the round aborts
+    # cleanly: the global model (and server-optimizer state) is left
+    # bit-identical to its pre-round value — no partial average — the
+    # clients are re-synced to that global, and the round re-runs.
+    # 0.0 (default) = the old behavior: aggregate whatever arrived.
+    round_quorum: float = 0.0
+    # FT timing constants, previously hardcoded in the transport/ft stack
+    # (docs/FAULT_TOLERANCE.md): the backup's promotion watchdog window,
+    # the dead-client re-probe period, and the async reply-queue poll.
+    ft_watchdog_timeout_s: float = 10.0
+    ft_heartbeat_period_s: float = 1.0
+    async_poll_s: float = 1.0
 
 
 def resolve_server_pipeline(fed: FedConfig) -> str:

@@ -8,6 +8,12 @@ Two distinct mechanisms, as in the reference (SURVEY §5):
   watchdog, promote/demote state machine with per-round model replication
   (reference ``src/server.py:183-264``), rebuilt event-driven and
   fake-clock-testable.
+
+Plus the machinery that *proves* both under real gRPC:
+- fault injection — :mod:`fedtpu.ft.chaos`: a seeded, scriptable
+  :class:`FaultSchedule` (delay/drop/error/corrupt/kill) applied via
+  channel and server interceptors, armed by ``--chaos-spec`` on the CLIs
+  (docs/FAULT_TOLERANCE.md; driven end-to-end by ``tools/chaos_soak.py``).
 """
 
 from fedtpu.ft.heartbeat import ClientRegistry, HeartbeatMonitor
@@ -17,6 +23,7 @@ from fedtpu.ft.failover import (
     Role,
     WatchdogRunner,
 )
+from fedtpu.ft.chaos import FaultRule, FaultSchedule, parse_spec as parse_chaos_spec
 
 __all__ = [
     "ClientRegistry",
@@ -25,4 +32,7 @@ __all__ = [
     "PrimaryPinger",
     "Role",
     "WatchdogRunner",
+    "FaultRule",
+    "FaultSchedule",
+    "parse_chaos_spec",
 ]

@@ -1,0 +1,491 @@
+"""Deterministic fault injection (chaos) for the gRPC federation edge.
+
+The FT machinery of this package — heartbeat revival, retry/backoff
+(:mod:`fedtpu.transport.retry`), round quorum, primary/backup failover —
+exists for faults, yet nothing in the repo could *produce* a fault against
+the live transport short of manually killing processes (the reference's
+only drill, SURVEY §4). This module is the missing half: a seeded,
+scriptable :class:`FaultSchedule` of :class:`FaultRule` entries applied via
+gRPC client-channel and server interceptors, so multi-process soaks
+(``tools/chaos_soak.py``) replay bit-identically from a spec string.
+
+Fault kinds:
+
+- ``delay``   — sleep ``delay_s`` before the call proceeds (straggler /
+  congested-edge simulation; composes with round deadlines).
+- ``drop``    — sleep ``delay_s``, then fail with DEADLINE_EXCEEDED
+  (a blackholed packet, time-compressed so soaks stay fast).
+- ``error``   — fail immediately with status ``code`` (default
+  UNAVAILABLE — the classic transient).
+- ``corrupt`` — deliver the RPC but flip the last byte of its payload
+  (``TrainReply.message`` / ``SendModelRequest.model``), exercising the
+  wire-CRC reject-and-retry path.
+- ``kill``    — SIGKILL the *current process* (use ``max=1`` for the
+  one-shot mid-round primary kill of the failover drills).
+
+Determinism: each (rule, rpc, peer) triple keeps its own draw counter, and
+the n-th draw fires iff ``crc32(f"{seed}|{rule}|{rpc}|{peer}|{n}") / 2^32 <
+p``. The decision therefore depends only on the seed and on that peer's own
+call sequence for that RPC — not on cross-peer thread interleaving — so a
+re-run with the same spec injects the same faults at the same points.
+
+Spec format (``--chaos-spec`` on all four CLIs): either a JSON object
+``{"seed": 7, "rules": [{"kind": "error", "rpc": "StartTrain", "p": 0.3}]}``
+or the mini-DSL ``kind@rpc:key=val,...`` with rules joined by ``;`` —
+e.g. ``error@StartTrain:p=0.3,seed=7;delay@SendModel:p=0.1,delay=0.5``.
+Keys: ``p`` (probability), ``peer``, ``delay`` (seconds), ``code``
+(grpc status name), ``rounds`` (``lo-hi`` half-open window or a single
+round), ``max`` (total injection cap), ``consec`` (max consecutive fires
+per stream — what makes a rule transient BY CONSTRUCTION; pair
+``consec < retry attempts`` with unbounded ``p`` faults), ``seed``
+(schedule-wide).
+
+Every injected fault increments ``fedtpu_chaos_injected_total{kind,rpc}``
+and lands in the flight recorder, so a post-mortem dump shows exactly
+which faults preceded a failure. The engine CLIs (``run``/``train``) have
+no RPC edge; there the schedule's :meth:`FaultSchedule.tick_round` applies
+``delay``/``kill`` rules keyed on the pseudo-RPC ``Round``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("fedtpu.chaos")
+
+KINDS = ("delay", "drop", "error", "corrupt", "kill")
+# The service's RPC surface plus the engine loops' pseudo-RPC.
+RPC_NAMES = (
+    "StartTrain", "SendModel", "HeartBeat", "CheckIfPrimaryUp",
+    "FetchModel", "Round", "*",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One scriptable fault: WHAT to inject (``kind`` + parameters) and
+    WHERE (rpc name, peer, round window, probability, total cap)."""
+
+    kind: str
+    rpc: str = "*"
+    peer: str = "*"
+    p: float = 1.0
+    delay_s: float = 0.25
+    code: str = "UNAVAILABLE"
+    # Half-open [lo, hi) coordinator-round window; None = every round.
+    # Only consulted where a round is known (the coordinator sets it).
+    rounds: Optional[Tuple[int, int]] = None
+    # Total injections this rule may ever perform (None = unbounded);
+    # max=1 is the one-shot process kill.
+    max_injections: Optional[int] = None
+    # Cap on CONSECUTIVE fires per (rule, rpc, peer) stream: after this
+    # many in a row the rule passes until one of its draws passes
+    # naturally (only a drawn pass re-arms the streak). This is what makes
+    # a rule *transient by construction* — an unbounded Bernoulli stream
+    # eventually produces an outage longer than any retry budget, which is
+    # a different fault class. A soak that must prove "zero clients die of
+    # transients" pairs consec < retry attempts. None = unbounded
+    # (outage-style rules).
+    max_consecutive: Optional[int] = None
+
+    def validate(self) -> "FaultRule":
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; have {'|'.join(KINDS)}"
+            )
+        if self.rpc not in RPC_NAMES:
+            raise ValueError(
+                f"unknown rpc {self.rpc!r}; have {'|'.join(RPC_NAMES)}"
+            )
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"fault p must be in [0, 1], got {self.p}")
+        if self.delay_s < 0:
+            raise ValueError(f"fault delay must be >= 0, got {self.delay_s}")
+        if self.max_injections is not None and self.max_injections < 1:
+            raise ValueError("fault max must be >= 1")
+        if self.max_consecutive is not None and self.max_consecutive < 1:
+            raise ValueError("fault consec must be >= 1")
+        return self
+
+
+class FaultSchedule:
+    """Seeded schedule of fault rules, consulted per RPC by the
+    interceptors. Thread-safe; one instance is shared by every channel and
+    server of a process."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = [r.validate() for r in rules]
+        self.seed = int(seed)
+        self._counts: Dict[Tuple[int, str, str], int] = {}
+        # Consecutive-fire run length per (rule, rpc, peer) stream, for
+        # max_consecutive enforcement.
+        self._streak: Dict[Tuple[int, str, str], int] = {}
+        self._fired = [0] * len(self.rules)
+        self._round: Optional[int] = None
+        self._lock = threading.Lock()
+        self._metrics = None
+        self._flight = None
+
+    # ------------------------------------------------------------ wiring
+    def attach(self, metrics=None, flight=None) -> "FaultSchedule":
+        """Hook the owning component's metrics registry / flight recorder
+        (later attach calls with None keep earlier hooks)."""
+        if metrics is not None:
+            self._metrics = metrics
+        if flight is not None:
+            self._flight = flight
+        return self
+
+    def set_round(self, round_idx: int) -> None:
+        """The coordinator advertises its current round so ``rounds=``
+        windows can key on it (peers without a round match any window)."""
+        self._round = int(round_idx)
+
+    # ---------------------------------------------------------- decision
+    def _matches(self, rule: FaultRule, rpc: str, peer: str) -> bool:
+        if rule.rpc != "*" and rule.rpc != rpc:
+            return False
+        if rule.peer != "*" and rule.peer != peer:
+            return False
+        if rule.rounds is not None and self._round is not None:
+            lo, hi = rule.rounds
+            if not lo <= self._round < hi:
+                return False
+        return True
+
+    def decide(self, rpc: str, peer: str = "*") -> Optional[FaultRule]:
+        """First rule that fires for this call, advancing the deterministic
+        draw counters; None = the call proceeds untouched. Counting happens
+        here (not at apply time) so the decision itself is the injection
+        event of record."""
+        fired = None
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if not self._matches(rule, rpc, peer):
+                    continue
+                if (rule.max_injections is not None
+                        and self._fired[i] >= rule.max_injections):
+                    continue
+                key = (i, rpc, peer)
+                n = self._counts.get(key, 0)
+                self._counts[key] = n + 1
+                draw = f"{self.seed}|{i}|{rpc}|{peer}|{n}".encode()
+                u = (zlib.crc32(draw) & 0xFFFFFFFF) / 2**32
+                capped = (
+                    rule.max_consecutive is not None
+                    and self._streak.get(key, 0) >= rule.max_consecutive
+                )
+                if u < rule.p and not capped:
+                    self._streak[key] = self._streak.get(key, 0) + 1
+                    self._fired[i] += 1
+                    fired = rule
+                    break
+                if u >= rule.p:
+                    # Only a DRAWN pass re-arms a capped stream (a forced
+                    # pass leaves the streak at the cap): a capped rule
+                    # stays silent while its draws keep firing, so a
+                    # multi-rule schedule cannot alternate its resets into
+                    # an unbounded outage — each rule fires at most
+                    # max_consecutive times between drawn passes.
+                    self._streak[key] = 0
+        if fired is not None:
+            self._record(fired, rpc, peer)
+        return fired
+
+    def _record(self, rule: FaultRule, rpc: str, peer: str) -> None:
+        log.warning(
+            "chaos: injecting %s on %s%s (round=%s)",
+            rule.kind, rpc, f" -> {peer}" if peer != "*" else "", self._round,
+        )
+        if self._metrics is not None:
+            self._metrics.counter(
+                "fedtpu_chaos_injected_total",
+                "faults injected by the chaos schedule, by kind and rpc",
+                labels={"kind": rule.kind, "rpc": rpc},
+            ).inc()
+        if self._flight is not None:
+            self._flight.record(
+                "chaos", fault=rule.kind, rpc=rpc, peer=peer,
+                round=self._round,
+            )
+
+    def injected_total(self) -> int:
+        with self._lock:
+            return sum(self._fired)
+
+    def describe(self) -> str:
+        """Startup-log line: the armed rules, compactly."""
+        parts = []
+        for r in self.rules:
+            opts = [f"p={r.p:g}"]
+            if r.peer != "*":
+                opts.append(f"peer={r.peer}")
+            if r.rounds is not None:
+                opts.append(f"rounds={r.rounds[0]}-{r.rounds[1]}")
+            if r.max_injections is not None:
+                opts.append(f"max={r.max_injections}")
+            if r.max_consecutive is not None:
+                opts.append(f"consec={r.max_consecutive}")
+            parts.append(f"{r.kind}@{r.rpc}:{','.join(opts)}")
+        return f"seed={self.seed} " + "; ".join(parts)
+
+    # ------------------------------------------------------- application
+    def _kill(self, rpc: str) -> None:
+        # Flush the flight recorder synchronously first: SIGKILL leaves no
+        # exit path, and the dump is the whole point of the drill.
+        log.warning("chaos: SIGKILL of pid %d (rule on %s)", os.getpid(), rpc)
+        if self._flight is not None:
+            try:
+                self._flight.dump(reason="chaos:kill")
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def apply_precall(self, rule: FaultRule, rpc: str) -> None:
+        """Client-side pre-call application of a fired rule (``corrupt`` is
+        applied to the response instead)."""
+        import grpc
+
+        if rule.kind == "delay":
+            time.sleep(rule.delay_s)
+        elif rule.kind == "drop":
+            time.sleep(rule.delay_s)
+            raise ChaosRpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                "chaos: dropped request")
+        elif rule.kind == "error":
+            raise ChaosRpcError(getattr(grpc.StatusCode, rule.code),
+                                "chaos: injected error")
+        elif rule.kind == "kill":
+            self._kill(rpc)
+
+    def tick_round(self, round_idx: int) -> None:
+        """Engine-loop hook for the RPC-less CLIs (``run``/``train``): one
+        consult of the pseudo-RPC ``Round`` per round/epoch. Only
+        ``delay`` and ``kill`` are meaningful without a wire; other kinds
+        are counted but ignored (parse-time warning)."""
+        self.set_round(round_idx)
+        rule = self.decide("Round")
+        if rule is None:
+            return
+        if rule.kind == "delay":
+            time.sleep(rule.delay_s)
+        elif rule.kind == "kill":
+            self._kill("Round")
+
+    # ------------------------------------------------------ interceptors
+    def client_interceptor(self, peer: str):
+        """A ``grpc.UnaryUnaryClientInterceptor`` injecting this schedule's
+        faults on every RPC issued over one channel to ``peer``."""
+        import grpc
+
+        schedule = self
+
+        class _CorruptedCall:
+            """Wraps the continuation's call so ``result()`` hands back a
+            payload-corrupted response; everything else delegates."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def result(self, timeout=None):
+                return _corrupt_message(self._inner.result())
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        class _ChaosClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+            def intercept_unary_unary(self, continuation,
+                                      client_call_details, request):
+                rpc = client_call_details.method.rsplit("/", 1)[-1]
+                rule = schedule.decide(rpc, peer)
+                if rule is not None and rule.kind != "corrupt":
+                    schedule.apply_precall(rule, rpc)
+                call = continuation(client_call_details, request)
+                if rule is not None and rule.kind == "corrupt":
+                    return _CorruptedCall(call)
+                return call
+
+        return _ChaosClientInterceptor()
+
+    def server_interceptor(self):
+        """A ``grpc.ServerInterceptor`` injecting this schedule's faults on
+        every inbound unary RPC (peer is unknown server-side: ``"*"``)."""
+        import grpc
+
+        schedule = self
+
+        class _ChaosServerInterceptor(grpc.ServerInterceptor):
+            def intercept_service(self, continuation, handler_call_details):
+                handler = continuation(handler_call_details)
+                if handler is None or handler.unary_unary is None:
+                    return handler
+                rpc = handler_call_details.method.rsplit("/", 1)[-1]
+                inner = handler.unary_unary
+
+                def behavior(request, context):
+                    rule = schedule.decide(rpc)
+                    if rule is not None:
+                        if rule.kind in ("delay", "drop"):
+                            time.sleep(rule.delay_s)
+                            if rule.kind == "drop":
+                                context.abort(
+                                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                                    "chaos: dropped reply",
+                                )
+                        elif rule.kind == "error":
+                            context.abort(
+                                getattr(grpc.StatusCode, rule.code),
+                                "chaos: injected error",
+                            )
+                        elif rule.kind == "kill":
+                            schedule._kill(rpc)
+                    response = inner(request, context)
+                    if rule is not None and rule.kind == "corrupt":
+                        response = _corrupt_message(response)
+                    return response
+
+                return grpc.unary_unary_rpc_method_handler(
+                    behavior,
+                    request_deserializer=handler.request_deserializer,
+                    response_serializer=handler.response_serializer,
+                )
+
+        return _ChaosServerInterceptor()
+
+
+_CHAOS_ERROR_TYPE = None
+
+
+def ChaosRpcError(code, details: str):
+    """An injected RPC failure: a real ``grpc.RpcError`` subclass (built
+    lazily so this module imports without grpc), so every existing
+    ``except grpc.RpcError`` — and the retry classifier — handles injected
+    faults exactly like wire-originated ones."""
+    global _CHAOS_ERROR_TYPE
+    if _CHAOS_ERROR_TYPE is None:
+        import grpc
+
+        class _ChaosRpcError(grpc.RpcError):
+            def __init__(self, code, details):
+                super().__init__(f"chaos: {code} ({details})")
+                self._code = code
+                self._details = details
+
+            def code(self):
+                return self._code
+
+            def details(self):
+                return self._details
+
+        _CHAOS_ERROR_TYPE = _ChaosRpcError
+    return _CHAOS_ERROR_TYPE(code, details)
+
+
+def _corrupt_message(msg):
+    """Flip the last byte of the message's (largest) bytes payload — past
+    the wire header, so the CRC (not the magic check) catches it. Messages
+    without a non-empty bytes field pass through untouched."""
+    target, size = None, 0
+    for field in getattr(msg, "__dataclass_fields__", {}):
+        value = getattr(msg, field)
+        if isinstance(value, (bytes, bytearray)) and len(value) > size:
+            target, size = field, len(value)
+    if target is None:
+        return msg
+    raw = bytearray(getattr(msg, target))
+    raw[-1] ^= 0xFF
+    setattr(msg, target, bytes(raw))
+    return msg
+
+
+# ------------------------------------------------------------------ parsing
+def parse_spec(spec: Optional[str]) -> Optional[FaultSchedule]:
+    """``--chaos-spec`` string -> armed :class:`FaultSchedule` (None for
+    empty/absent). JSON when the string starts with ``{``; the mini-DSL
+    otherwise. Raises ValueError with the offending fragment on bad input.
+    """
+    if spec is None or not spec.strip():
+        return None
+    spec = spec.strip()
+    if spec.startswith("{"):
+        return _parse_json(spec)
+    return _parse_dsl(spec)
+
+
+def _parse_json(spec: str) -> FaultSchedule:
+    try:
+        obj = json.loads(spec)
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"chaos spec is not valid JSON: {exc}") from exc
+    rules = []
+    for raw in obj.get("rules", []):
+        rules.append(_rule_from(dict(raw)))
+    if not rules:
+        raise ValueError("chaos spec has no rules")
+    return FaultSchedule(rules, seed=int(obj.get("seed", 0)))
+
+
+def _parse_dsl(spec: str) -> FaultSchedule:
+    rules, seed = [], 0
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, opt_str = part.partition(":")
+        kind, _, rpc = head.partition("@")
+        fields: dict = {"kind": kind.strip(), "rpc": rpc.strip() or "*"}
+        for opt in filter(None, (o.strip() for o in opt_str.split(","))):
+            key, eq, val = opt.partition("=")
+            if not eq:
+                raise ValueError(f"chaos option {opt!r} is not key=value")
+            key = key.strip()
+            val = val.strip()
+            if key == "seed":
+                seed = int(val)
+            elif key in ("p", "peer", "code", "rounds"):
+                fields[key] = val
+            elif key == "delay":
+                fields["delay_s"] = val
+            elif key == "max":
+                fields["max_injections"] = val
+            elif key == "consec":
+                fields["max_consecutive"] = val
+            else:
+                raise ValueError(
+                    f"unknown chaos option {key!r} in {part!r}; have "
+                    "p|peer|delay|code|rounds|max|consec|seed"
+                )
+        rules.append(_rule_from(fields))
+    if not rules:
+        raise ValueError("chaos spec has no rules")
+    return FaultSchedule(rules, seed=seed)
+
+
+def _rule_from(fields: dict) -> FaultRule:
+    if "rounds" in fields and not isinstance(fields["rounds"], (tuple, list)):
+        lo, dash, hi = str(fields["rounds"]).partition("-")
+        fields["rounds"] = (int(lo), int(hi)) if dash else (
+            int(lo), int(lo) + 1
+        )
+    if "rounds" in fields and fields["rounds"] is not None:
+        fields["rounds"] = tuple(int(x) for x in fields["rounds"])
+    for key in ("p", "delay_s"):
+        if key in fields:
+            fields[key] = float(fields[key])
+    for key in ("max_injections", "max_consecutive"):
+        if key in fields and fields[key] is not None:
+            fields[key] = int(fields[key])
+    unknown = set(fields) - {
+        f.name for f in dataclasses.fields(FaultRule)
+    }
+    if unknown:
+        raise ValueError(f"unknown chaos rule fields {sorted(unknown)}")
+    return FaultRule(**fields)

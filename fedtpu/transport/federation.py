@@ -25,6 +25,7 @@ genuinely separate processes/hosts federating over a network edge.
 from __future__ import annotations
 
 import logging
+import math
 import os
 import threading
 import time
@@ -36,7 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedtpu import models as model_zoo
-from fedtpu.config import RoundConfig, resolve_server_pipeline
+from fedtpu.config import (
+    RoundConfig,
+    resolve_server_pipeline,
+    validate_retry_policy,
+)
 from fedtpu.core.client import make_eval_fn, make_local_update
 from fedtpu.core import optim
 from fedtpu.data import load, dataset_info
@@ -52,6 +57,7 @@ from fedtpu.obs import FlightRecorder, StatusBoard, Telemetry
 from fedtpu.obs import propagate
 from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
+from fedtpu.transport.retry import call_with_retry
 from fedtpu.transport.service import (
     TrainerServicer,
     TrainerStub,
@@ -326,14 +332,21 @@ class ClientAgent(TrainerServicer):
 
 
 def serve_client(
-    address: str, cfg: RoundConfig, seed: int = 0, compress: bool = False
+    address: str, cfg: RoundConfig, seed: int = 0, compress: bool = False,
+    chaos=None,
 ):
     """Build + start a client agent server on ``address`` (parity:
-    ``serve``, ``src/client.py:38-52``). Returns (server, agent)."""
+    ``serve``, ``src/client.py:38-52``). Returns (server, agent).
+    ``chaos`` (a :class:`fedtpu.ft.chaos.FaultSchedule`) arms fault
+    injection on this agent's INBOUND RPCs — the client-side half of a
+    chaos drill."""
     agent = ClientAgent(cfg, seed=seed)
     # The bind address doubles as the client's trace/flight identity.
     agent.trainer.telemetry.role = f"client:{address}"
-    server = create_server(address, agent, compress=compress)
+    if chaos is not None:
+        chaos.attach(metrics=agent.trainer.telemetry.registry
+                     if agent.trainer.telemetry.enabled else None)
+    server = create_server(address, agent, compress=compress, chaos=chaos)
     server.start()
     return server, agent
 
@@ -356,20 +369,64 @@ class PrimaryServer:
         compress: bool = False,
         seed: int = 0,
         initial_model: Optional[bytes] = None,
-        rpc_timeout: float = 600.0,
+        rpc_timeout: Optional[float] = None,
         round_deadline_s: Optional[float] = None,
         flight: Optional[FlightRecorder] = None,
+        chaos=None,
     ):
         """``round_deadline_s``: straggler mitigation — wait at most this
         long for StartTrain replies each round, then aggregate whatever
         arrived. Stragglers stay ALIVE (they still get the broadcast and
         rejoin next round), unlike RpcError failures; the reference's
         barrier blocks on its slowest client unconditionally
-        (``src/server.py:132-135``). None = reference behavior."""
+        (``src/server.py:132-135``). None = reference behavior.
+
+        ``rpc_timeout``: legacy blanket deadline — when given it overrides
+        the per-RPC data-plane deadlines of ``cfg.fed.retry`` (the typed
+        :class:`fedtpu.config.RetryPolicy` that replaced the old scattered
+        constants). ``chaos``: a :class:`fedtpu.ft.chaos.FaultSchedule` —
+        every outbound channel then carries the fault-injection
+        interceptor (deterministic, seeded; see docs/FAULT_TOLERANCE.md).
+        """
         self.cfg = cfg
         self.compress = compress
-        self.rpc_timeout = rpc_timeout
         self.round_deadline_s = round_deadline_s
+        rp = validate_retry_policy(cfg.fed.retry)
+        self.retry_policy = rp
+        # Per-RPC deadlines from the policy; an explicit rpc_timeout= keeps
+        # the old blanket-override surface for the data-plane RPCs.
+        self._deadlines = {
+            "StartTrain": rpc_timeout if rpc_timeout is not None
+            else rp.start_train_timeout_s,
+            "SendModel": rpc_timeout if rpc_timeout is not None
+            else rp.send_model_timeout_s,
+            "FetchModel": rpc_timeout if rpc_timeout is not None
+            else rp.fetch_model_timeout_s,
+            "HeartBeat": rp.probe_timeout_s,
+            "CheckIfPrimaryUp": rp.backup_ping_timeout_s,
+        }
+        # Legacy attribute: the data-plane deadline some callers/tests read.
+        self.rpc_timeout = self._deadlines["SendModel"]
+        if not 0.0 <= cfg.fed.round_quorum <= 1.0:
+            raise ValueError(
+                f"round_quorum must be in [0, 1], got {cfg.fed.round_quorum}"
+            )
+        self.chaos = chaos
+        # The resolved timing surface, logged once so operators can read a
+        # run's effective deadlines off the startup log instead of chasing
+        # constants through the source (docs/OPERATIONS.md).
+        log.info(
+            "transport timings: start_train=%.1fs send_model=%.1fs "
+            "fetch_model=%.1fs probe=%.1fs backup_ping=%.1fs "
+            "heartbeat_period=%.1fs retries=%d backoff=%.2fs*%.1f<=%.1fs "
+            "round_quorum=%.2f chaos=%s",
+            self._deadlines["StartTrain"], self._deadlines["SendModel"],
+            self._deadlines["FetchModel"], self._deadlines["HeartBeat"],
+            self._deadlines["CheckIfPrimaryUp"],
+            cfg.fed.ft_heartbeat_period_s, rp.max_attempts, rp.backoff_s,
+            rp.backoff_multiplier, rp.backoff_max_s, cfg.fed.round_quorum,
+            chaos.describe() if chaos is not None else "off",
+        )
         self.telemetry = Telemetry(cfg.fed.telemetry, role="primary")
         # Flight recorder: bounded black box of recent spans, round marks,
         # and warning+ events — dumpable at any moment (obs/flight.py). The
@@ -445,27 +502,35 @@ class PrimaryServer:
             self._install(initial_model)
 
         _metrics = self.telemetry.registry if self.telemetry.enabled else None
+        if chaos is not None:
+            chaos.attach(metrics=_metrics, flight=self.flight)
         self.registry = ClientRegistry(clients, metrics=_metrics)
         # Every outbound channel (StartTrain/SendModel fan-out, heartbeat
         # probes, backup pings/replication/FetchModel) carries the
         # trace-propagation interceptor; _trace_source yields None below
-        # trace mode, so the interceptor is a single no-op call then.
+        # trace mode, so the interceptor is a single no-op call then. The
+        # chaos interceptor (when armed) wraps outermost, keyed by peer.
         self._stubs: Dict[str, TrainerStub] = {
             c: TrainerStub(create_channel(
-                c, compress=compress, trace_source=self._trace_source))
+                c, compress=compress, trace_source=self._trace_source,
+                chaos=chaos))
             for c in clients
         }
         self.backup_stub = (
             TrainerStub(create_channel(
                 backup_address, compress=compress,
-                trace_source=self._trace_source))
+                trace_source=self._trace_source, chaos=chaos))
             if backup_address
             else None
         )
         self.monitor = HeartbeatMonitor(
             self.registry,
-            probe=lambda c: probe(self._stubs[c]) is not None,
+            probe=lambda c: probe(
+                self._stubs[c], timeout=self._deadlines["HeartBeat"],
+                policy=rp, telemetry=self.telemetry,
+            ) is not None,
             resync=self._resync,
+            period=cfg.fed.ft_heartbeat_period_s,
             metrics=_metrics,
         )
         self.pinger = (
@@ -718,9 +783,15 @@ class PrimaryServer:
                 f"stale broadcast to {client} still in flight; "
                 "deferring resync"
             )
-        self._stubs[client].SendModel(
-            proto.SendModelRequest(model=self.model_bytes()),
-            timeout=self.rpc_timeout,
+        # A transient blip mid-resync retries here instead of bouncing the
+        # client back to dead for another full heartbeat cycle.
+        call_with_retry(
+            self.retry_policy, "SendModel",
+            lambda: self._stubs[client].SendModel(
+                proto.SendModelRequest(model=self.model_bytes()),
+                timeout=self._deadlines["SendModel"],
+            ),
+            peer=client, telemetry=self.telemetry,
         )
 
     def sync_clients(self) -> None:
@@ -729,39 +800,68 @@ class PrimaryServer:
         Runs automatically before the first round (see :meth:`round`):
         clients may hold baselines from a previous server generation, and in
         sparse-delta mode an unsynced baseline would silently corrupt
-        aggregation.
+        aggregation. Transient failures retry under the policy — one blip
+        here used to kill the client before round 1 ever ran.
         """
         payload = self.model_bytes()
         for client in self.registry.active_clients():
             try:
-                self._stubs[client].SendModel(
-                    proto.SendModelRequest(model=payload), timeout=self.rpc_timeout
+                call_with_retry(
+                    self.retry_policy, "SendModel",
+                    lambda c=client: self._stubs[c].SendModel(
+                        proto.SendModelRequest(model=payload),
+                        timeout=self._deadlines["SendModel"],
+                    ),
+                    peer=client, telemetry=self.telemetry,
                 )
             except grpc.RpcError:
                 log.warning("client %s failed during initial sync", client)
+                self.telemetry.counter(
+                    "fedtpu_rpc_failures_total",
+                    "RpcErrors by failing RPC",
+                    labels={"rpc": "SendModel"},
+                ).inc()
                 self.registry.mark_failed(client)
         self._did_initial_sync = True
 
     def _ping_backup(self, recovering: bool) -> Optional[int]:
         try:
-            resp = self.backup_stub.CheckIfPrimaryUp(
-                proto.PingRequest(req=b"1" if recovering else b"0"), timeout=2.0
+            resp = call_with_retry(
+                self.retry_policy, "CheckIfPrimaryUp",
+                lambda: self.backup_stub.CheckIfPrimaryUp(
+                    proto.PingRequest(req=b"1" if recovering else b"0"),
+                    timeout=self._deadlines["CheckIfPrimaryUp"],
+                ),
+                telemetry=self.telemetry,
             )
         except grpc.RpcError:
             return None
         if resp.value == 1:
             # The backup acted as primary while we were down; its model is
             # ahead of ours. Pull it before training another round (the
-            # reference silently reverts the backup's progress here).
+            # reference silently reverts the backup's progress here). The
+            # retry also re-requests a CRC-corrupted replica payload.
             try:
-                fetched = self.backup_stub.FetchModel(
-                    proto.Request(), timeout=self.rpc_timeout
+                def fetch():
+                    fetched = self.backup_stub.FetchModel(
+                        proto.Request(),
+                        timeout=self._deadlines["FetchModel"],
+                    )
+                    if fetched.model:
+                        self._install(fetched.model)
+                        log.info("recovered newer global model from backup")
+
+                call_with_retry(
+                    self.retry_policy, "FetchModel", fetch,
+                    telemetry=self.telemetry,
                 )
-                if fetched.model:
-                    self._install(fetched.model)
-                    log.info("recovered newer global model from backup")
             except grpc.RpcError:
                 log.warning("backup demoted but FetchModel failed")
+            except wire.WireError:
+                log.warning(
+                    "backup demoted but its model payload stayed corrupt "
+                    "after retries; keeping the local model"
+                )
         return resp.value
 
     # ---------------------------------------------------------- observability
@@ -795,7 +895,12 @@ class PrimaryServer:
             stragglers_in_flight=sorted(
                 c for c, t in self._inflight.items() if t.is_alive()
             ),
-            rounds_completed=len(self.history),
+            rounds_completed=sum(
+                1 for rec in self.history if not rec.get("aborted")
+            ),
+            rounds_aborted=sum(
+                1 for rec in self.history if rec.get("aborted")
+            ),
         )
         tel = self.telemetry
         if tel.enabled:
@@ -830,6 +935,11 @@ class PrimaryServer:
         with tel.span("round", round=self._round_counter) as rspan:
             rec = self._round_body(rspan)
         self.status.update(phase="idle")
+        if rec.get("aborted"):
+            # Sub-quorum abort: the abort already logged its own flight
+            # event and counter inside _round_body; it is NOT a completed
+            # round (the counter below would lie to dashboards).
+            return rec
         self.flight.record(
             "round",
             round=self._round_counter - 1,
@@ -867,6 +977,9 @@ class PrimaryServer:
         cfg = self.cfg
         tel = self.telemetry
         self.status.update(round=self._round_counter, phase="collect")
+        if self.chaos is not None:
+            # Advertise the lineage round so rounds= fault windows key on it.
+            self.chaos.set_round(self._round_counter)
         if not self._did_initial_sync:
             self.sync_clients()
         active = self.registry.active_clients()
@@ -949,90 +1062,109 @@ class PrimaryServer:
             # this round's span EXPLICITLY (thread-local nesting cannot
             # cross threads); decode/h2d spans below nest under it via the
             # worker's own stack.
+            def attempt():
+                # One full RPC attempt INCLUDING reply decode: a payload
+                # that fails the wire CRC (corrupted in flight) raises
+                # WireError here and is re-requested by the retry wrapper
+                # — reject-and-retry, never "silently lose the client's
+                # round" (the pre-policy behavior: the worker thread died
+                # with the exception and the reply just vanished).
+                reply = self._stubs[client].StartTrain(
+                    proto.TrainRequest(rank=rank, world=world),
+                    timeout=self._deadlines["StartTrain"],
+                )
+                data = reply.message
+                if stream:
+                    # Decode straight into this client's row — no
+                    # per-leaf template trees, no later leaf-by-leaf
+                    # stacking. A retried attempt rewrites the row from
+                    # scratch (both decoders write every real coordinate).
+                    row = host_rows[0][row_of[client]]
+                    t0 = time.monotonic()
+                    with tel.span("decode", client=client):
+                        if sparse.is_sparse_payload(data):
+                            extra = sparse.decode_into_row(
+                                data, self._flat_layout.sizes, row
+                            )
+                        else:
+                            # Dense full weights -> delta against the
+                            # round's global, written into the row leaf
+                            # slices.
+                            extra = wire.decode_into_row(
+                                data,
+                                _payload_template(self.model, cfg),
+                                global_host(),
+                                row,
+                            )
+                    t1 = time.monotonic()
+                    # Ship the row NOW: the transfer (and the in-place
+                    # device-buffer write) overlaps the remaining
+                    # clients' network wait instead of queueing behind
+                    # the barrier. A deadline straggler landing AFTER
+                    # the round closed its buffer (the pop in the
+                    # finalize below) skips the device write: its reply
+                    # is excluded from this round anyway, and writing
+                    # would donate a buffer handle the finalize may
+                    # still be reading.
+                    with tel.span("h2d", client=client):
+                        dev_row = jax.device_put(row)
+                        with stream_lock:
+                            if dev_buf:
+                                dev_buf[0] = self._set_row(
+                                    dev_buf[0], dev_row, row_of[client]
+                                )
+                    t2 = time.monotonic()
+                    decode_s.inc(t1 - t0)
+                    h2d_s.inc(t2 - t1)
+                    out = (row_of[client], float(extra["num_examples"]))
+                elif sparse.is_sparse_payload(data):
+                    t0 = time.monotonic()
+                    with tel.span("decode", client=client):
+                        deltas, extra = sparse.decode(
+                            data, delta_template()
+                        )
+                    decode_s.inc(time.monotonic() - t0)
+                    out = (deltas, float(extra["num_examples"]))
+                else:
+                    t0 = time.monotonic()
+                    with tel.span("decode", client=client):
+                        tree = wire.decode(
+                            data, _payload_template(self.model, cfg)
+                        )
+                        # Dense full weights -> delta against the
+                        # round's global, so dense and sparse replies
+                        # aggregate uniformly.
+                        delta = jax.tree.map(
+                            lambda a, g: np.asarray(a) - g,
+                            {"params": tree["params"],
+                             "batch_stats": tree["batch_stats"]},
+                            global_host(),
+                        )
+                    decode_s.inc(time.monotonic() - t0)
+                    out = (delta, float(tree["num_examples"]))
+                # Count only the attempt that survived decode.
+                bytes_up.inc(len(data))
+                return out
+
             try:
                 with tel.span("client_rpc", parent=rspan.id, client=client):
-                    reply = self._stubs[client].StartTrain(
-                        proto.TrainRequest(rank=rank, world=world),
-                        timeout=self.rpc_timeout,
+                    results[client] = call_with_retry(
+                        self.retry_policy, "StartTrain", attempt,
+                        peer=client, telemetry=tel,
                     )
-                    data = reply.message
-                    bytes_up.inc(len(data))
-                    if stream:
-                        # Decode straight into this client's row — no
-                        # per-leaf template trees, no later leaf-by-leaf
-                        # stacking.
-                        row = host_rows[0][row_of[client]]
-                        t0 = time.monotonic()
-                        with tel.span("decode", client=client):
-                            if sparse.is_sparse_payload(data):
-                                extra = sparse.decode_into_row(
-                                    data, self._flat_layout.sizes, row
-                                )
-                            else:
-                                # Dense full weights -> delta against the
-                                # round's global, written into the row leaf
-                                # slices.
-                                extra = wire.decode_into_row(
-                                    data,
-                                    _payload_template(self.model, cfg),
-                                    global_host(),
-                                    row,
-                                )
-                        t1 = time.monotonic()
-                        # Ship the row NOW: the transfer (and the in-place
-                        # device-buffer write) overlaps the remaining
-                        # clients' network wait instead of queueing behind
-                        # the barrier. A deadline straggler landing AFTER
-                        # the round closed its buffer (the pop in the
-                        # finalize below) skips the device write: its reply
-                        # is excluded from this round anyway, and writing
-                        # would donate a buffer handle the finalize may
-                        # still be reading.
-                        with tel.span("h2d", client=client):
-                            dev_row = jax.device_put(row)
-                            with stream_lock:
-                                if dev_buf:
-                                    dev_buf[0] = self._set_row(
-                                        dev_buf[0], dev_row, row_of[client]
-                                    )
-                        t2 = time.monotonic()
-                        decode_s.inc(t1 - t0)
-                        h2d_s.inc(t2 - t1)
-                        results[client] = (
-                            row_of[client], float(extra["num_examples"])
-                        )
-                    elif sparse.is_sparse_payload(data):
-                        t0 = time.monotonic()
-                        with tel.span("decode", client=client):
-                            deltas, extra = sparse.decode(
-                                data, delta_template()
-                            )
-                        decode_s.inc(time.monotonic() - t0)
-                        results[client] = (
-                            deltas, float(extra["num_examples"])
-                        )
-                    else:
-                        t0 = time.monotonic()
-                        with tel.span("decode", client=client):
-                            tree = wire.decode(
-                                data, _payload_template(self.model, cfg)
-                            )
-                            # Dense full weights -> delta against the
-                            # round's global, so dense and sparse replies
-                            # aggregate uniformly.
-                            delta = jax.tree.map(
-                                lambda a, g: np.asarray(a) - g,
-                                {"params": tree["params"],
-                                 "batch_stats": tree["batch_stats"]},
-                                global_host(),
-                            )
-                        decode_s.inc(time.monotonic() - t0)
-                        results[client] = (delta, float(tree["num_examples"]))
-            except grpc.RpcError as e:
-                log.warning(
-                    "client %s failed during StartTrain: %s %s",
-                    client, e.code(), e.details(),
-                )
+            except (grpc.RpcError, wire.WireError) as e:
+                # Only a FATAL status or an exhausted retry budget lands
+                # here — the designed path to mark_failed.
+                if isinstance(e, grpc.RpcError):
+                    log.warning(
+                        "client %s failed during StartTrain: %s %s",
+                        client, e.code(), e.details(),
+                    )
+                else:
+                    log.warning(
+                        "client %s StartTrain reply still corrupt after "
+                        "retries: %s", client, e,
+                    )
                 tel.counter(
                     "fedtpu_rpc_failures_total",
                     "RpcErrors by failing RPC",
@@ -1134,6 +1266,57 @@ class PrimaryServer:
             for c in active
             if c in results and c not in stragglers
         }
+
+        # Round quorum (cfg.fed.round_quorum, fraction of this round's
+        # SAMPLED clients): below it the round aborts CLEANLY — the global
+        # model and server-optimizer state are left bit-identical to their
+        # pre-round values (nothing below this point runs, so there is no
+        # partial average to undo), the lineage counter does not advance,
+        # and the caller re-runs the round (run()'s abort loop). Clearing
+        # _did_initial_sync forces a re-broadcast of the unchanged global
+        # before the re-run: clients that DID train this round have
+        # advanced their local weights, and in sparse-delta mode their next
+        # delta must be computed against the server's global, not that
+        # drift.
+        quorum = cfg.fed.round_quorum
+        needed = max(1, math.ceil(quorum * len(active))) if quorum > 0 else 0
+        if needed and len(completed) < needed:
+            with stream_lock:
+                dev_buf.clear()  # close the stream buffer; rows discarded
+            self._did_initial_sync = False
+            log.warning(
+                "round %d aborted: %d/%d replies below quorum %.2f of %d "
+                "sampled clients; global model untouched, will re-run",
+                self._round_counter, len(completed), needed, quorum,
+                len(active),
+            )
+            tel.counter(
+                "fedtpu_round_aborts_total",
+                "rounds aborted below quorum (global model untouched)",
+            ).inc()
+            self.flight.record(
+                "round_abort", round=self._round_counter,
+                participants=len(completed), quorum_needed=needed,
+            )
+            rec = {
+                "participants": len(completed),
+                "stragglers": len(stragglers),
+                "world": world,
+                "alive": self.registry.alive_mask().tolist(),
+                "aborted": True,
+                "quorum_needed": needed,
+                "bytes_up": int(bytes_up.value),
+                "bytes_down": 0,
+                "pipeline": self.server_pipeline,
+                "t_collect_s": round(t_barrier - t_launch, 6),
+                "t_decode_s": round(decode_s.value, 6),
+                "t_h2d_s": round(h2d_s.value, 6),
+                "t_aggregate_s": 0.0,
+                "t_post_barrier_s": 0.0,
+            }
+            self.history.append(rec)
+            return rec
+
         self.status.update(phase="aggregate")
         if completed:
             with tel.span("aggregate", participants=len(completed)):
@@ -1205,9 +1388,13 @@ class PrimaryServer:
             replica = self.replica_bytes()
             try:
                 with tel.span("replicate", parent=rspan.id):
-                    self.backup_stub.SendModel(
-                        proto.SendModelRequest(model=replica),
-                        timeout=self.rpc_timeout,
+                    call_with_retry(
+                        self.retry_policy, "SendModel",
+                        lambda: self.backup_stub.SendModel(
+                            proto.SendModelRequest(model=replica),
+                            timeout=self._deadlines["SendModel"],
+                        ),
+                        peer="backup", telemetry=tel,
                     )
                 bytes_down.inc(len(replica))
             except grpc.RpcError:
@@ -1221,9 +1408,13 @@ class PrimaryServer:
         def send_one(client: str) -> None:
             try:
                 with tel.span("broadcast", parent=rspan.id, client=client):
-                    self._stubs[client].SendModel(
-                        proto.SendModelRequest(model=payload),
-                        timeout=self.rpc_timeout,
+                    call_with_retry(
+                        self.retry_policy, "SendModel",
+                        lambda: self._stubs[client].SendModel(
+                            proto.SendModelRequest(model=payload),
+                            timeout=self._deadlines["SendModel"],
+                        ),
+                        peer=client, telemetry=tel,
                     )
                 bytes_down.inc(len(payload))
             except grpc.RpcError as e:
@@ -1389,30 +1580,46 @@ class PrimaryServer:
                 try:
                     with version_lock:
                         base_version, payload, base = current[0]
-                    self._stubs[client].SendModel(
-                        proto.SendModelRequest(model=payload),
-                        timeout=self.rpc_timeout,
+                    call_with_retry(
+                        self.retry_policy, "SendModel",
+                        lambda: self._stubs[client].SendModel(
+                            proto.SendModelRequest(model=payload),
+                            timeout=self._deadlines["SendModel"],
+                        ),
+                        peer=client, telemetry=tel,
                     )
                     tel.counter(
                         "fedtpu_rpc_bytes_down_total",
                         "server -> client/backup broadcast bytes (successful)",
                     ).inc(len(payload))
-                    reply = self._stubs[client].StartTrain(
-                        proto.TrainRequest(
-                            # Each client keeps its OWN registry-order shard;
-                            # the synchronous path assigns the same stable
-                            # ranks (see round()'s rank_of).
-                            rank=rank, world=len(self.registry.clients)
-                        ),
-                        timeout=self.rpc_timeout,
+
+                    def train_attempt():
+                        # RPC + decode as one retryable unit: a corrupt
+                        # reply (WireError) is re-requested like any
+                        # transient (see round()'s train_one).
+                        reply = self._stubs[client].StartTrain(
+                            proto.TrainRequest(
+                                # Each client keeps its OWN registry-order
+                                # shard; the synchronous path assigns the
+                                # same stable ranks (see round()'s rank_of).
+                                rank=rank, world=len(self.registry.clients)
+                            ),
+                            timeout=self._deadlines["StartTrain"],
+                        )
+                        tree = wire.decode(
+                            reply.message,
+                            _payload_template(self.model, self.cfg),
+                        )
+                        return reply, tree
+
+                    reply, tree = call_with_retry(
+                        self.retry_policy, "StartTrain", train_attempt,
+                        peer=client, telemetry=tel,
                     )
                     tel.counter(
                         "fedtpu_rpc_bytes_up_total",
                         "client -> server StartTrain reply bytes (successful)",
                     ).inc(len(reply.message))
-                    tree = wire.decode(
-                        reply.message, _payload_template(self.model, self.cfg)
-                    )
                     delta = jax.tree.map(
                         lambda a, g: np.asarray(a) - g,
                         {"params": tree["params"],
@@ -1423,11 +1630,17 @@ class PrimaryServer:
                         (client, delta, float(tree["num_examples"]),
                          base_version)
                     )
-                except grpc.RpcError as e:
-                    log.warning(
-                        "async client %s failed: %s %s",
-                        client, e.code(), e.details(),
-                    )
+                except (grpc.RpcError, wire.WireError) as e:
+                    if isinstance(e, grpc.RpcError):
+                        log.warning(
+                            "async client %s failed: %s %s",
+                            client, e.code(), e.details(),
+                        )
+                    else:
+                        log.warning(
+                            "async client %s reply still corrupt after "
+                            "retries: %s", client, e,
+                        )
                     tel.counter(
                         "fedtpu_rpc_failures_total",
                         "RpcErrors by failing RPC",
@@ -1459,6 +1672,16 @@ class PrimaryServer:
                 all_dead_since[0] = time.monotonic()
             return time.monotonic() - all_dead_since[0] > 10.0
 
+        poll_s = fed.async_poll_s
+        # Async quorum (cfg.fed.round_quorum): an update only applies while
+        # at least that fraction of the REGISTRY is alive — below it the
+        # buffered deltas are held (global untouched) until the heartbeat
+        # monitor revives enough clients, the async analogue of the
+        # synchronous round abort. 0 = apply whenever buffer_k arrive.
+        quorum_n = (
+            max(1, math.ceil(fed.round_quorum * len(self.registry.clients)))
+            if fed.round_quorum > 0 else 0
+        )
         try:
             while self._async_version < num_updates:
                 if stop is not None and stop():
@@ -1466,7 +1689,7 @@ class PrimaryServer:
                 buf = []
                 while len(buf) < buffer_k:
                     try:
-                        buf.append(replies.get(timeout=1.0))
+                        buf.append(replies.get(timeout=poll_s))
                     except queue.Empty:
                         if (stop is not None and stop()) or hopeless():
                             break
@@ -1475,6 +1698,23 @@ class PrimaryServer:
                         log.warning("all async clients dead; stopping")
                         break
                     continue
+                if quorum_n and len(self.registry.active_clients()) < quorum_n:
+                    log.warning(
+                        "async update held: %d alive < quorum %d; waiting "
+                        "for recovery",
+                        len(self.registry.active_clients()), quorum_n,
+                    )
+                    tel.counter(
+                        "fedtpu_round_aborts_total",
+                        "rounds aborted below quorum (global model untouched)",
+                    ).inc()
+                    while (len(self.registry.active_clients()) < quorum_n
+                           and not hopeless()
+                           and not (stop is not None and stop())):
+                        time.sleep(poll_s)
+                    if len(self.registry.active_clients()) < quorum_n:
+                        log.warning("quorum never recovered; stopping")
+                        break
                 with tel.span("async_update"), version_lock:
                     v = self._async_version
                     stalenesses = [v - b for _, _, _, b in buf]
@@ -1522,9 +1762,15 @@ class PrimaryServer:
                     current[0] = snapshot()
                 if self.backup_stub is not None:
                     try:
-                        self.backup_stub.SendModel(
-                            proto.SendModelRequest(model=self.replica_bytes()),
-                            timeout=self.rpc_timeout,
+                        call_with_retry(
+                            self.retry_policy, "SendModel",
+                            lambda: self.backup_stub.SendModel(
+                                proto.SendModelRequest(
+                                    model=self.replica_bytes()
+                                ),
+                                timeout=self._deadlines["SendModel"],
+                            ),
+                            peer="backup", telemetry=tel,
                         )
                     except grpc.RpcError:
                         log.warning("backup unreachable during replication")
@@ -1596,14 +1842,37 @@ class PrimaryServer:
         # (see sync_clients) — after the pinger tick above, so a model
         # fetched from a demoting backup is what gets synced.
         try:
-            for r in range(num_rounds):
+            r = 0
+            consecutive_aborts = 0
+            while r < num_rounds:
                 if stop is not None and stop():
                     log.info("round loop stopped (demotion) after %d rounds", r)
                     break
                 rec = self.round()
+                if rec.get("aborted"):
+                    # Sub-quorum round: the global is untouched; re-run it
+                    # once the heartbeat monitor (running in this loop) has
+                    # had a chance to revive clients. The abort IS reported
+                    # (an ``aborted: true`` record in the round log — an
+                    # operator must see it), it just doesn't count toward
+                    # num_rounds. A federation that NEVER recovers must not
+                    # spin forever.
+                    if on_round is not None:
+                        on_round(r, rec)
+                    consecutive_aborts += 1
+                    if consecutive_aborts >= 50:
+                        log.error(
+                            "round %d aborted %d times in a row below "
+                            "quorum; giving up", r, consecutive_aborts,
+                        )
+                        break
+                    time.sleep(self.monitor.period)
+                    continue
+                consecutive_aborts = 0
                 log.info("round %d: %s", r, rec)
                 if on_round is not None:
                     on_round(r, rec)
+                r += 1
         finally:
             self.monitor.stop()
             if self.pinger is not None:
@@ -1624,16 +1893,25 @@ class BackupServer(TrainerServicer):
         cfg: RoundConfig,
         clients: List[str],
         compress: bool = False,
-        watchdog_timeout: float = 10.0,
+        watchdog_timeout: Optional[float] = None,
         round_deadline_s: Optional[float] = None,
         flight: Optional[FlightRecorder] = None,
+        chaos=None,
     ):
         self.cfg = cfg
         self.clients = clients
         self.compress = compress
         # Forwarded to the acting PrimaryServer on promotion, so straggler
-        # mitigation survives failover.
+        # mitigation (and fault injection) survive failover.
         self.round_deadline_s = round_deadline_s
+        self.chaos = chaos
+        if watchdog_timeout is None:
+            watchdog_timeout = cfg.fed.ft_watchdog_timeout_s
+        log.info(
+            "backup timings: watchdog=%.1fs chaos=%s",
+            watchdog_timeout,
+            chaos.describe() if chaos is not None else "off",
+        )
         self.latest_model: Optional[bytes] = None
         self.acting: Optional[PrimaryServer] = None
         self.telemetry = Telemetry(cfg.fed.telemetry, role="backup")
@@ -1716,6 +1994,7 @@ class BackupServer(TrainerServicer):
                 initial_model=self.latest_model,
                 round_deadline_s=self.round_deadline_s,
                 flight=self.flight,
+                chaos=self.chaos,
             )
         except wire.WireError:
             # A corrupted replica must fail loudly — but not by silently
@@ -1732,6 +2011,7 @@ class BackupServer(TrainerServicer):
                 compress=self.compress,
                 round_deadline_s=self.round_deadline_s,
                 flight=self.flight,
+                chaos=self.chaos,
             )
         self.acting = acting
 
@@ -1765,7 +2045,9 @@ class BackupServer(TrainerServicer):
 
     def start(self, address: str):
         """Host the backup servicer + watchdog; returns the grpc server."""
-        server = create_server(address, self, compress=self.compress)
+        server = create_server(
+            address, self, compress=self.compress, chaos=self.chaos
+        )
         server.start()
         self.watchdog.start()
         return server

@@ -119,11 +119,13 @@ def trace_context_of(context):
 
 
 def create_channel(address: str, compress: bool = False,
-                   trace_source=None) -> grpc.Channel:
+                   trace_source=None, chaos=None) -> grpc.Channel:
     """Insecure channel with 1 GiB caps and optional gzip (parity:
     ``createChannel``, ``src/server.py:103-107``). ``trace_source`` (a
     ``() -> Optional[TraceContext]``) wraps the channel with the
-    trace-propagation interceptor; None keeps the plain channel."""
+    trace-propagation interceptor; ``chaos`` (a
+    :class:`fedtpu.ft.chaos.FaultSchedule`) with the fault-injection
+    interceptor keyed to this peer. None keeps the plain channel."""
     kwargs = {}
     if compress:
         kwargs["compression"] = grpc.Compression.Gzip
@@ -132,6 +134,10 @@ def create_channel(address: str, compress: bool = False,
         from fedtpu.obs import propagate
 
         channel = propagate.instrument_channel(channel, trace_source)
+    if chaos is not None:
+        channel = grpc.intercept_channel(
+            channel, chaos.client_interceptor(address)
+        )
     return channel
 
 
@@ -140,13 +146,17 @@ def create_server(
     servicer: TrainerServicer,
     compress: bool = False,
     max_workers: int = 10,
+    chaos=None,
 ) -> grpc.Server:
     """Build (not start) a server hosting ``servicer`` on ``address``
     (parity: ``serve``, ``src/client.py:38-52`` — 10 workers, 1 GiB caps,
-    optional gzip, insecure port)."""
+    optional gzip, insecure port). ``chaos`` arms the server-side
+    fault-injection interceptor on every inbound RPC."""
     kwargs = {}
     if compress:
         kwargs["compression"] = grpc.Compression.Gzip
+    if chaos is not None:
+        kwargs["interceptors"] = (chaos.server_interceptor(),)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
@@ -158,11 +168,22 @@ def create_server(
 
 
 def probe(
-    stub: TrainerStub, timeout: float = 1.0
+    stub: TrainerStub, timeout: float = 1.0, policy=None, telemetry=None
 ) -> Optional[proto.HeartBeatResponse]:
     """One HeartBeat RPC; None on any RpcError (the reference's liveness
-    probe semantics, ``src/server.py:86-99``)."""
+    probe semantics, ``src/server.py:86-99``). With ``policy`` (a
+    :class:`fedtpu.config.RetryPolicy`) transient failures retry with
+    backoff first, so a one-packet blip during an FT probe doesn't read as
+    a dead peer."""
     try:
-        return stub.HeartBeat(proto.Request(), timeout=timeout)
+        if policy is None:
+            return stub.HeartBeat(proto.Request(), timeout=timeout)
+        from fedtpu.transport.retry import call_with_retry
+
+        return call_with_retry(
+            policy, "HeartBeat",
+            lambda: stub.HeartBeat(proto.Request(), timeout=timeout),
+            telemetry=telemetry,
+        )
     except grpc.RpcError:
         return None

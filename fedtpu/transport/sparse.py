@@ -51,7 +51,9 @@ from fedtpu.transport.wire import WireError, frame as _wire_frame, unframe as _w
 Pytree = Any
 
 _MAGIC = b"FSP1"
-_VERSION = 1
+# Tracks the shared frame version (fedtpu.transport.wire): v2 frames CRC
+# the header bytes too; v1 frames from older senders still decode.
+_VERSION = 2
 _HEADER = struct.Struct("<4sBBI")
 
 

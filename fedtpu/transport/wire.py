@@ -20,6 +20,13 @@ payload. The receiver selects its decode template from this flag instead of
 guessing by trying templates and catching exceptions — a corrupted or
 config-mismatched replica therefore fails loudly rather than silently
 downgrading to "model-only, drop the moments".
+
+Version history: v1 frames CRC the payload only, so a bit-flipped
+``flags`` byte could silently re-kind (or un-zlib) an otherwise-valid
+payload. v2 (current) extends the CRC over ``version | flags | payload``,
+closing the header hole. Decoders accept BOTH: v1 frames produced by older
+peers or read back from old checkpoints still decode (each version is
+checked under its own CRC rule), so a mixed-version fleet interoperates.
 """
 
 from __future__ import annotations
@@ -35,7 +42,7 @@ from flax import serialization
 Pytree = Any
 
 _MAGIC = b"FTP1"
-_VERSION = 1
+_VERSION = 2
 _FLAG_ZLIB = 1
 _FLAG_REPLICA = 2
 _HEADER = struct.Struct("<4sBBI")
@@ -45,31 +52,45 @@ class WireError(ValueError):
     """Malformed or corrupted payload."""
 
 
+def _crc(version: int, flags: int, payload: bytes) -> int:
+    """The frame checksum under each version's coverage rule: v1 covered
+    the payload only; v2+ also covers the version and flags bytes, so a
+    corrupted header fails the CRC instead of silently re-kinding the
+    payload."""
+    if version == 1:
+        return zlib.crc32(payload) & 0xFFFFFFFF
+    return zlib.crc32(payload, zlib.crc32(bytes((version, flags)))) & 0xFFFFFFFF
+
+
 def frame(
     magic: bytes, payload: bytes, flags: int = 0, version: int = _VERSION
 ) -> bytes:
     """Frame ``payload`` under the shared fedtpu header layout
     ``magic(4) | version(1) | flags(1) | crc32(4)`` — ONE implementation for
     every wire format (dense ``FTP1`` here, sparse/flat ``FSP1`` in
-    :mod:`fedtpu.transport.sparse`), so the header structs cannot drift."""
-    return (
-        _HEADER.pack(magic, version, flags, zlib.crc32(payload) & 0xFFFFFFFF)
-        + payload
-    )
+    :mod:`fedtpu.transport.sparse`), so the header structs cannot drift.
+    ``version=1`` emits a legacy frame (payload-only CRC) for compat
+    testing; current frames are v2 (header+payload CRC)."""
+    if not 1 <= version <= _VERSION:
+        raise ValueError(f"unknown frame version {version}")
+    return _HEADER.pack(magic, version, flags, _crc(version, flags, payload)) + payload
 
 
 def unframe(
     magic: bytes, data: bytes, what: str = "wire", version: int = _VERSION
 ):
     """Validate + strip a :func:`frame` header; returns ``(flags, payload)``.
-    Raises :class:`WireError` on wrong magic, version, or CRC."""
+    ``version`` is the NEWEST version the caller understands — every frame
+    version from 1 up to it decodes, each checked under its own CRC rule
+    (old frames from pre-v2 peers/checkpoints stay readable). Raises
+    :class:`WireError` on wrong magic, unknown version, or CRC mismatch."""
     if len(data) < _HEADER.size or data[:4] != magic:
         raise WireError(f"not a fedtpu {what} payload")
     _, ver, flags, crc = _HEADER.unpack_from(data)
-    if ver != version:
+    if not 1 <= ver <= version:
         raise WireError(f"unsupported {what} version {ver}")
     payload = data[_HEADER.size :]
-    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+    if _crc(ver, flags, payload) != crc:
         raise WireError(f"{what} payload CRC mismatch")
     return flags, payload
 
@@ -101,7 +122,7 @@ def payload_kind(data: bytes) -> str:
     if len(data) < _HEADER.size or data[:4] != _MAGIC:
         raise WireError("not a fedtpu wire payload")
     _, version, flags, _ = _HEADER.unpack_from(data)
-    if version != _VERSION:
+    if not 1 <= version <= _VERSION:
         raise WireError(f"unsupported wire version {version}")
     return "replica" if flags & _FLAG_REPLICA else "model"
 

@@ -267,6 +267,37 @@ def test_obs_plane_microbench_contract(bench, monkeypatch, tmp_path):
         assert json_mod.load(f) == result
 
 
+def test_chaos_overhead_microbench_contract(bench, monkeypatch, tmp_path):
+    """--chaos-overhead-microbench at a seconds-scale config: schema +
+    artifact emission (the <=1%-on-densenet acceptance gate itself is
+    pinned by the committed artifacts/CHAOS_OVERHEAD_MICROBENCH.json run).
+    """
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_CH_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_CH_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_CH_REPS", "2")
+    result = bench._chaos_overhead_microbench()
+    assert result["metric"] == "chaos_overhead"
+    assert result["value"] > 0
+    assert result["per_rpc_us"]["decide"] > 0
+    # The attributable arithmetic is auditable from its own parts:
+    # two consults (StartTrain + SendModel) per client per round.
+    per_round = result["num_clients"] * 2 * result["per_rpc_us"]["decide"]
+    assert result["per_round_chaos_us"] == pytest.approx(per_round, rel=1e-3)
+    assert result["gate_pct"] == 1.0
+    assert isinstance(result["passes_gate"], bool)
+    assert result["noise_floor_pct"] >= 0
+    assert set(result["round_ms"]) == {"bare", "chaos"}
+    assert all(v > 0 for v in result["round_ms"].values())
+    path = os.path.join(str(art), "CHAOS_OVERHEAD_MICROBENCH.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
 def test_telemetry_microbench_contract(bench, monkeypatch, tmp_path):
     """--telemetry-microbench at a seconds-scale config: schema, artifact
     emission, and a valid trace-check leg (the <1%-on-densenet acceptance

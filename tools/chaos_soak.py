@@ -1,0 +1,422 @@
+#!/usr/bin/env python
+"""Chaos soak: N federated rounds under a seeded fault schedule, including
+a mid-round primary kill -> backup promotion -> primary recovery, driven
+against the LIVE gRPC transport.
+
+What it proves (the acceptance spine of the chaos/resilience PR;
+docs/FAULT_TOLERANCE.md):
+
+1. **Transient faults never kill clients.** The schedule injects transient
+   RPC errors (and corrupt payloads) on >=30% of StartTrain calls;
+   the retry policy absorbs them (``fedtpu_rpc_retries_total`` > 0,
+   ``fedtpu_ft_client_deaths_total`` == 0).
+2. **Sub-quorum rounds abort without mutating the global model.** A
+   pre-flight in-process drill forces a below-quorum round and asserts the
+   post-abort params/opt-state are BIT-IDENTICAL to the pre-round
+   snapshot; the multi-process phase then schedules a full-round delay
+   burst so a real abort (straggler-shaped, no deaths) appears in the
+   round log and training still completes.
+3. **Failover under fire.** A ``kill@StartTrain:rounds=K,max=1`` rule
+   SIGKILLs the primary mid-round; the backup watchdog promotes, the
+   acting primary commits rounds with the full client fleet, and a
+   restarted primary demotes it, pulls the newer model, and finishes the
+   run with a finite final eval on every client.
+
+Topology: client agents + backup in THIS process (their state is
+inspectable), the primary as a real subprocess of ``fedtpu.cli.server``
+(so SIGKILL is a genuine process death over a genuine network edge).
+
+Usage::
+
+    python tools/chaos_soak.py                  # full soak, ~2-3 min
+    python tools/chaos_soak.py --rounds 8 --kill-round 3   # quicker
+
+Writes ``artifacts/CHAOS_SOAK.json`` and exits non-zero on any failed
+assertion. The fast tier-1 chaos leg lives in ``tests/test_chaos.py``;
+the full soak runs there too, marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _scrape_metrics(port: int) -> dict:
+    """{metric_name: {labelstr: value}} from a live /metrics endpoint."""
+    from fedtpu.obs import parse_prometheus_text
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as resp:
+        return parse_prometheus_text(resp.read().decode())
+
+
+def _read_records(path: str) -> list:
+    from fedtpu.obs import read_round_records
+
+    if not os.path.exists(path):
+        return []
+    return read_round_records(path)
+
+
+def _committed(records: list) -> int:
+    return sum(1 for r in records if not r.get("aborted"))
+
+
+def _tiny_cfg(num_clients: int, rounds: int, **fed_kw):
+    from fedtpu.config import (
+        DataConfig, FedConfig, OptimizerConfig, RoundConfig,
+    )
+
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(num_clients=num_clients, num_rounds=rounds, **fed_kw),
+        steps_per_round=2,
+    )
+
+
+def quorum_drill(seed: int = 7) -> dict:
+    """In-process sub-quorum abort with the bit-identical restore assert:
+    a chaos rule fails EVERY StartTrain of one round; with round_quorum=1.0
+    the round must abort leaving params, server-opt state, and the round
+    counter byte-for-byte untouched, and the next round (faults exhausted)
+    must commit."""
+    import numpy as np
+    import jax
+
+    from fedtpu.config import RetryPolicy
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    n, attempts = 2, 2
+    cfg = _tiny_cfg(
+        n, 4,
+        round_quorum=1.0,
+        server_optimizer="momentum",
+        retry=RetryPolicy(max_attempts=attempts, backoff_s=0.01),
+    )
+    # Enough injections to exhaust every retry of every client for exactly
+    # one round; afterwards the rule is spent and rounds commit.
+    chaos = parse_spec(
+        f"error@StartTrain:p=1.0,max={n * attempts},seed={seed}"
+    )
+    servers = []
+    try:
+        addrs = []
+        for i in range(n):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            addrs.append(addr)
+        primary = PrimaryServer(cfg, addrs, chaos=chaos)
+        # p=1.0 on every StartTrain attempt: round 0 exhausts every
+        # client's retry budget (the designed mark_failed path) and lands
+        # below quorum -> abort.
+        rec0 = primary.round()
+        assert rec0.get("aborted"), f"expected round 0 abort, got {rec0}"
+        state_after_abort = jax.tree.map(np.asarray, primary.state_tree())
+        fresh = PrimaryServer(cfg, [])  # same seed -> same init
+        state_initial = jax.tree.map(np.asarray, fresh.state_tree())
+        mismatch = []
+        jax.tree.map(
+            lambda a, b: mismatch.append(True)
+            if not np.array_equal(a, b) else None,
+            state_after_abort, state_initial,
+        )
+        assert not mismatch, "aborted round mutated the global state"
+        # Revive the exhausted clients (their servers are healthy — only
+        # the schedule was hostile) and re-run: the rule is spent, so the
+        # re-run commits with the full fleet.
+        deadline = time.monotonic() + 30
+        while primary.registry.dead_clients() and time.monotonic() < deadline:
+            primary.monitor.tick()
+        rec1 = primary.round()
+        assert not rec1.get("aborted") and rec1["participants"] == n, rec1
+        return {
+            "aborted_round_bit_identical": True,
+            "recommit_participants": rec1["participants"],
+            "chaos_injected": chaos.injected_total(),
+        }
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+def run_soak(
+    rounds: int = 20,
+    clients: int = 3,
+    kill_round: int = 8,
+    quorum: float = 0.5,
+    seed: int = 7,
+    error_p: float = 0.3,
+    corrupt_p: float = 0.05,
+    retries: int = 8,
+    watchdog_s: float = 4.0,
+    workdir: str = "/tmp/fedtpu_chaos_soak",
+    verbose: bool = True,
+) -> dict:
+    """The full multi-process soak; returns the assertion/result dict."""
+    from fedtpu.transport.federation import BackupServer, serve_client
+
+    os.makedirs(workdir, exist_ok=True)
+    # Round-record writers APPEND: stale files from a previous soak in the
+    # same workdir would inflate the committed/aborted counts.
+    for name in os.listdir(workdir):
+        if name.startswith("primary_gen"):
+            os.unlink(os.path.join(workdir, name))
+    result: dict = {"config": {
+        "rounds": rounds, "clients": clients, "kill_round": kill_round,
+        "quorum": quorum, "seed": seed, "error_p": error_p,
+        "corrupt_p": corrupt_p, "retries": retries,
+    }}
+
+    def note(msg):
+        if verbose:
+            print(f"[soak] {msg}", flush=True)
+
+    note("phase 0: in-process quorum drill (bit-identical abort)")
+    result["quorum_drill"] = quorum_drill(seed=seed)
+
+    cfg = _tiny_cfg(clients, rounds)
+    agents, servers, addrs = [], [], []
+    backup_srv = None
+    procs = []
+    try:
+        for i in range(clients):
+            addr = f"localhost:{free_port()}"
+            server, agent = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            agents.append(agent)
+            addrs.append(addr)
+        backup_addr_port = free_port()
+        backup = BackupServer(cfg, addrs, watchdog_timeout=watchdog_s)
+        backup_srv = backup.start(f"localhost:{backup_addr_port}")
+
+        # The primary's schedule: transient errors + payload corruption on
+        # the StartTrain fan-out throughout, one full-round delay burst
+        # (straggler-shaped sub-quorum abort, nobody dies), and the
+        # one-shot mid-round SIGKILL. The consec caps make the
+        # error/corrupt rules transient BY CONSTRUCTION: the worst
+        # interleaved failure run is 2*3+1 = 7 attempts, strictly under
+        # the retry budget, so "zero transient deaths" holds for ANY seed
+        # and any port draw.
+        delay_round = max(2, kill_round // 2)
+        assert retries > 7, "retry budget must exceed the worst chaos run"
+        spec = (
+            f"kill@StartTrain:p=1.0,rounds={kill_round}-{kill_round + 1},"
+            f"max=1,seed={seed};"
+            f"delay@StartTrain:p=1.0,rounds={delay_round}-{delay_round + 1},"
+            f"max={clients},delay=6;"
+            f"error@StartTrain:p={error_p},consec=3;"
+            f"corrupt@StartTrain:p={corrupt_p},consec=1"
+        )
+        result["chaos_spec"] = spec
+
+        def launch_primary(gen: int, num_rounds: int, obs_port: int):
+            metrics = os.path.join(workdir, f"primary_gen{gen}.jsonl")
+            prom = os.path.join(workdir, f"primary_gen{gen}.prom")
+            cmd = [
+                sys.executable, "-m", "fedtpu.cli.server",
+                "--p", "y", "--platform", "cpu",
+                "--model", "mlp", "--dataset", "synthetic",
+                "--num-examples", "256", "--batch-size", "8",
+                "--eval-batch-size", "8",
+                "--rounds", str(num_rounds),
+                "--clients", ",".join(addrs),
+                "--backupAddress", "localhost",
+                "--backupPort", str(backup_addr_port),
+                "--metrics", metrics, "--prom-out", prom,
+                "--obs-port", str(obs_port),
+                "--chaos-spec", spec,
+                "--round-quorum", str(quorum),
+                "--round-deadline", "3",
+                "--rpc-retries", str(retries),
+                "--rpc-backoff", "0.02",
+                "--seed", "0",
+            ]
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                cmd, cwd=REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            return proc, metrics, prom
+
+        note(f"phase 1: primary gen 1 ({rounds} rounds, kill at "
+             f"round {kill_round}, delay burst at round {delay_round})")
+        obs1 = free_port()
+        p1, metrics1, prom1 = launch_primary(1, rounds, obs1)
+        procs.append(p1)
+        last_scrape: dict = {}
+        deadline = time.monotonic() + 600
+        while p1.poll() is None and time.monotonic() < deadline:
+            try:
+                last_scrape = _scrape_metrics(obs1)
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert p1.poll() is not None, "primary gen 1 never exited (no kill?)"
+        result["gen1_rc"] = p1.returncode
+        assert p1.returncode != 0, (
+            "primary gen 1 exited cleanly — the kill rule never fired"
+        )
+        recs1 = _read_records(metrics1)
+        result["gen1_committed"] = _committed(recs1)
+        result["gen1_aborted"] = len(recs1) - _committed(recs1)
+        deaths = sum(
+            last_scrape.get("fedtpu_ft_client_deaths_total", {}).values()
+        )
+        retried = sum(
+            last_scrape.get("fedtpu_rpc_retries_total", {}).values()
+        )
+        injected = sum(
+            last_scrape.get("fedtpu_chaos_injected_total", {}).values()
+        )
+        result["gen1_client_deaths"] = deaths
+        result["gen1_retries"] = retried
+        result["gen1_chaos_injected"] = injected
+        assert deaths == 0, (
+            f"{deaths} clients marked dead by transient faults (gen 1)"
+        )
+        assert retried > 0, "no RPC was ever retried under 30% fault load"
+
+        note("phase 2: waiting for backup promotion + acting rounds")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (backup.machine.role.value == "acting_primary"
+                    and backup.acting is not None
+                    and _committed(backup.acting.history) >= 1):
+                break
+            time.sleep(0.25)
+        result["promoted"] = backup.machine.role.value == "acting_primary"
+        acting_committed = (
+            _committed(backup.acting.history) if backup.acting else 0
+        )
+        result["acting_committed"] = acting_committed
+        assert result["promoted"], "backup never promoted after the kill"
+        assert acting_committed >= 1, "acting primary committed no rounds"
+
+        remaining = max(1, rounds - result["gen1_committed"])
+        note(f"phase 3: primary gen 2 ({remaining} rounds; demotes the "
+             "acting primary and pulls its model)")
+        obs2 = free_port()
+        p2, metrics2, prom2 = launch_primary(2, remaining, obs2)
+        procs.append(p2)
+        try:
+            p2.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+            raise AssertionError("primary gen 2 hung")
+        result["gen2_rc"] = p2.returncode
+        assert p2.returncode == 0, f"gen 2 failed rc={p2.returncode}"
+        recs2 = _read_records(metrics2)
+        result["gen2_committed"] = _committed(recs2)
+        with open(prom2) as fh:
+            from fedtpu.obs import parse_prometheus_text
+
+            prom2_metrics = parse_prometheus_text(fh.read())
+        deaths2 = sum(
+            prom2_metrics.get("fedtpu_ft_client_deaths_total", {}).values()
+        )
+        result["gen2_client_deaths"] = deaths2
+        result["gen2_retries"] = sum(
+            prom2_metrics.get("fedtpu_rpc_retries_total", {}).values()
+        )
+        assert deaths2 == 0, (
+            f"{deaths2} clients marked dead by transient faults (gen 2)"
+        )
+        assert backup.machine.role.value == "backup", (
+            "acting primary never demoted after gen 2's recovery ping"
+        )
+
+        total = (result["gen1_committed"] + acting_committed
+                 + result["gen2_committed"])
+        result["total_committed"] = total
+        assert total >= rounds, (
+            f"only {total} rounds committed across generations, "
+            f"wanted >= {rounds}"
+        )
+        assert result["gen1_aborted"] >= 1, (
+            "the full-round delay burst never produced a sub-quorum abort"
+        )
+
+        note("phase 4: final eval finiteness on every client")
+        evals = []
+        for agent in agents:
+            assert agent.last_eval is not None, "client never evaluated"
+            loss, acc = agent.last_eval
+            assert loss == loss and abs(loss) != float("inf"), loss
+            evals.append({"loss": loss, "acc": acc})
+        result["final_evals"] = evals
+        result["ok"] = True
+        return result
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        if backup_srv is not None:
+            backup.watchdog.stop()
+            backup._stop_acting(wait=10.0)
+            backup_srv.stop(0)
+        for s in servers:
+            s.stop(0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", default=20, type=int)
+    ap.add_argument("--clients", default=3, type=int)
+    ap.add_argument("--kill-round", default=8, type=int)
+    ap.add_argument("--quorum", default=0.5, type=float)
+    ap.add_argument("--seed", default=7, type=int)
+    ap.add_argument("--error-p", default=0.3, type=float)
+    ap.add_argument("--retries", default=8, type=int,
+                    help="retry budget; must exceed the worst interleaved "
+                    "chaos run (2*3+1 attempts under the default spec)")
+    ap.add_argument("--workdir", default="/tmp/fedtpu_chaos_soak")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        result = run_soak(
+            rounds=args.rounds, clients=args.clients,
+            kill_round=args.kill_round, quorum=args.quorum, seed=args.seed,
+            error_p=args.error_p, retries=args.retries,
+            workdir=args.workdir,
+        )
+    except AssertionError as exc:
+        print(json.dumps({"ok": False, "error": str(exc)}))
+        return 1
+    art = os.path.join(REPO, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    with open(os.path.join(art, "CHAOS_SOAK.json"), "w") as fh:
+        json.dump(result, fh, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1056,6 +1056,173 @@ def _chaos_overhead_microbench():
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
+def _cohort_scale():
+    """``cohort_scale``: clients-per-round vs round wall-clock for the
+    massive-cohort simulation engine (fedtpu.sim), on one host.
+
+    For a fixed simulated POPULATION, sweeps the per-round COHORT size
+    through the fused ``lax.scan`` engine and records, per point, the
+    round wall time and the device-side per-seat state footprint. Two
+    claims are made auditable:
+
+    - **scale**: the largest cohort actually runs (default sweep tops out
+      at 10k simulated clients in one round on this host);
+    - **O(cohort) device memory**: per-seat state bytes grow with the
+      cohort and are INDEPENDENT of the population — the same cohort is
+      re-measured at half the population and must report identical bytes
+      (``memory_model.o_cohort``). The population's only footprint is
+      host-side numpy tables (reported as ``host_table_bytes``).
+
+    Env knobs (shrunk by tests/test_bench.py): FEDTPU_CS_MODEL,
+    FEDTPU_CS_POPULATION, FEDTPU_CS_COHORTS, FEDTPU_CS_ROUNDS,
+    FEDTPU_CS_BATCH, FEDTPU_CS_STEPS, FEDTPU_CS_SCENARIO.
+
+    Run via ``python bench.py --cohort-scale``; prints one JSON line and
+    writes ``artifacts/COHORT_SCALE.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    from fedtpu.config import (
+        DataConfig, FedConfig, OptimizerConfig, RoundConfig, SimConfig,
+    )
+    from fedtpu.sim import SimFederation
+
+    model_name = os.environ.get("FEDTPU_CS_MODEL", "mlp_tiny")
+    population = int(os.environ.get("FEDTPU_CS_POPULATION", "10000"))
+    cohorts = [
+        int(c)
+        for c in os.environ.get(
+            "FEDTPU_CS_COHORTS", "64,256,1024,4096,10000"
+        ).split(",")
+    ]
+    rounds = int(os.environ.get("FEDTPU_CS_ROUNDS", "2"))
+    batch = int(os.environ.get("FEDTPU_CS_BATCH", "8"))
+    steps = int(os.environ.get("FEDTPU_CS_STEPS", "1"))
+    scenario = os.environ.get(
+        "FEDTPU_CS_SCENARIO", "dirichlet:alpha=0.3+quantity_skew:power=1.2"
+    )
+    num_examples = int(
+        os.environ.get("FEDTPU_CS_EXAMPLES", str(max(2 * population, 1000)))
+    )
+
+    def make_cfg(cohort: int) -> RoundConfig:
+        return RoundConfig(
+            model=model_name,
+            num_classes=10,
+            opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+            data=DataConfig(
+                dataset="synthetic", batch_size=batch, partition="iid",
+                num_examples=num_examples, device_layout="gather",
+            ),
+            fed=FedConfig(
+                num_clients=cohort,
+                sim=SimConfig(population=population, scenario=scenario),
+            ),
+            steps_per_round=steps,
+        )
+
+    def seat_state_bytes(fed, cohort: int) -> int:
+        """Device bytes of per-seat STATE — the exact footprint the
+        O(cohort) claim is about: the fields FederatedState stacks along
+        the clients axis (momentum, compressor residuals, PRNG keys, loss
+        observations). Global fields (params, batch stats, server-opt
+        moments) are excluded by construction, not by shape heuristics —
+        a param leaf's first dim can coincide with the cohort. The
+        assignment rows are reported separately: they are
+        O(cohort * shard_len) where shard_len is the partition's padded
+        max shard, which varies with the partition draw."""
+        per_seat = (
+            fed.state.opt_state,
+            fed.state.comp_state,
+            fed.state.client_rng,
+            fed.state.last_client_loss,
+        )
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(per_seat):
+            assert leaf.shape[0] == cohort, leaf.shape
+            total += leaf.size * leaf.dtype.itemsize
+        return int(total)
+
+    def measure(cohort: int, pop: int) -> dict:
+        import dataclasses
+
+        cfg = make_cfg(cohort)
+        if pop != population:
+            cfg = dataclasses.replace(
+                cfg,
+                fed=dataclasses.replace(
+                    cfg.fed,
+                    sim=dataclasses.replace(cfg.fed.sim, population=pop),
+                ),
+            )
+        fed = SimFederation(cfg, seed=0)
+        m = fed.run_on_device(1)  # compile + warmup
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+        t0 = time.perf_counter()
+        m = fed.run_on_device(rounds)
+        np.asarray(m.loss)
+        dt = (time.perf_counter() - t0) / rounds
+        pop_tables = fed.population
+        host_bytes = int(
+            pop_tables.idx.nbytes + pop_tables.mask.nbytes
+            + pop_tables.last_seen_loss.nbytes
+            + pop_tables.last_sampled_round.nbytes
+            + pop_tables.times_sampled.nbytes
+        )
+        clients = int(fed.alive.sum())
+        return {
+            "cohort": cohort,
+            "population": pop,
+            "clients_per_round": clients,
+            "round_s": round(dt, 4),
+            "clients_per_sec": round(clients / max(dt, 1e-9), 2),
+            "seat_state_bytes": seat_state_bytes(fed, cohort),
+            "assignment_bytes": int(
+                fed.client_idx.nbytes + fed.client_mask.nbytes
+            ),
+            "host_table_bytes": host_bytes,
+            "heterogeneity_index": round(fed._hetero, 4),
+        }
+
+    curve = [measure(c, population) for c in cohorts]
+    # O(cohort) proof: the SAME cohort at half the population must hold
+    # byte-identical seat state (population only grows host tables).
+    probe_cohort = cohorts[0]
+    half = measure(probe_cohort, max(probe_cohort, population // 2))
+    at_full = next(p for p in curve if p["cohort"] == probe_cohort)
+    result = {
+        "metric": "cohort_scale",
+        "unit": "simulated clients per round (device memory O(cohort))",
+        "value": max(p["clients_per_round"] for p in curve),
+        "population": population,
+        "scenario": scenario,
+        "model": model_name,
+        "batch": batch,
+        "steps_per_round": steps,
+        "rounds_per_point": rounds,
+        "curve": curve,
+        "memory_model": {
+            "cohort": probe_cohort,
+            "seat_state_bytes_full_population": at_full["seat_state_bytes"],
+            "seat_state_bytes_half_population": half["seat_state_bytes"],
+            "o_cohort": at_full["seat_state_bytes"]
+            == half["seat_state_bytes"],
+        },
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "COHORT_SCALE.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 def _live_artifact_pointer():
     """Most recent builder-captured live measurement, if any — attached to
     DIAGNOSTIC (value 0.0) outputs only, so a wedged-tunnel bench moment
@@ -1168,6 +1335,9 @@ def main():
         return
     if "--chaos-overhead-microbench" in sys.argv:
         print(json.dumps(_chaos_overhead_microbench()))
+        return
+    if "--cohort-scale" in sys.argv:
+        print(json.dumps(_cohort_scale()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

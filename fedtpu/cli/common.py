@@ -19,6 +19,7 @@ from fedtpu.config import (
     OptimizerConfig,
     RetryPolicy,
     RoundConfig,
+    SimConfig,
 )
 from fedtpu.data import dataset_info
 
@@ -222,6 +223,92 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         help="print per-batch loss/acc from inside the jitted local epoch "
         "(the reference's mid-epoch console lines, src/utils.py:51-92). "
         "Host callback per batch — debugging only, ruins throughput",
+    )
+
+
+def add_sim_flags(p: argparse.ArgumentParser) -> None:
+    """Massive-cohort simulation surface (fedtpu.sim; docs/SIMULATION.md).
+    Engine CLI only — the population/cohort split is a property of the
+    simulated path (the gRPC topology's population is its real clients)."""
+    p.add_argument(
+        "--population",
+        default=0,
+        type=int,
+        metavar="N",
+        help="simulate N clients total while the device holds only "
+        "--cohort of them per round (fedtpu.sim.SimFederation): per-client "
+        "dataset assignment + last-seen loss + availability live as host "
+        "tables, each round's cohort is gathered into the engine's "
+        "fixed-size buffers — device memory O(cohort), not O(population). "
+        "0 (default) = resident engine (every client a live device slot)",
+    )
+    p.add_argument(
+        "--cohort",
+        default=0,
+        type=int,
+        metavar="K",
+        help="clients per round when --population is set (the engine's "
+        "device-buffer size; overrides --num-clients). population == "
+        "cohort with uniform sampling reproduces the resident engine "
+        "bit-for-bit (test-pinned)",
+    )
+    p.add_argument(
+        "--scenario",
+        default="",
+        metavar="SPEC",
+        help="population heterogeneity scenario (fedtpu.sim.scenario): "
+        "base[:k=v,...][+quantity_skew:power=P] with bases iid | "
+        "dirichlet:alpha=A | pathological:shards=S | label_skew:classes=C "
+        "| quantity_skew:power=P | round_robin. Empty = use --partition "
+        "unchanged. Example: 'dirichlet:alpha=0.1+quantity_skew:power=1.5'",
+    )
+    p.add_argument(
+        "--cohort-sampler",
+        default="uniform",
+        choices=["uniform", "loss"],
+        help="how each round's cohort is drawn from the available "
+        "population: uniform without replacement, or loss = proportional "
+        "to last-seen training loss (never-sampled clients draw at an "
+        "optimistic prior, so exploration never starves)",
+    )
+    p.add_argument(
+        "--availability",
+        default=1.0,
+        type=float,
+        metavar="FRACTION",
+        help="stationary fraction of the population that is online "
+        "(seeded two-state Markov trace; 1.0 = everyone always up)",
+    )
+    p.add_argument(
+        "--churn",
+        default=0.0,
+        type=float,
+        metavar="P",
+        help="per-round P(online -> offline) of the availability trace "
+        "(P(offline -> online) is derived to keep --availability "
+        "stationary); 0 = a frozen availability draw",
+    )
+    p.add_argument(
+        "--loss-prior",
+        default=-1.0,
+        type=float,
+        metavar="LOSS",
+        help="optimistic sampling prior for never-sampled clients under "
+        "--cohort-sampler loss; negative (default) = the max observed loss",
+    )
+
+
+def sim_config(args) -> SimConfig:
+    """SimConfig from the sim flags (defaults when a CLI doesn't expose
+    them — server/train CLIs build sim-off configs)."""
+    return SimConfig(
+        population=getattr(args, "population", 0),
+        cohort_sampler=getattr(args, "cohort_sampler", "uniform"),
+        scenario=getattr(args, "scenario", ""),
+        loss_prior=getattr(args, "loss_prior", -1.0),
+        availability=getattr(args, "availability", 1.0),
+        churn=getattr(args, "churn", 0.0),
+        seed=getattr(args, "sim_seed", 0),
     )
 
 
@@ -549,6 +636,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
                 args, "participation_sampling", "uniform"
             ),
             telemetry=getattr(args, "telemetry", "basic"),
+            sim=sim_config(args),
             **robustness_config(args),
         ),
         steps_per_round=steps_per_round,

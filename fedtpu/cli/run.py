@@ -21,6 +21,7 @@ from fedtpu.cli.common import (
     add_obs_flags,
     add_platform_flag,
     add_robustness_flags,
+    add_sim_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
@@ -41,6 +42,7 @@ def main(argv=None) -> int:
     add_fed_flags(p)
     p.add_argument("--num-clients", default=2, type=int)
     p.add_argument("--steps-per-round", default=8, type=int)
+    add_sim_flags(p)
     p.add_argument(
         "--mesh",
         default="auto",
@@ -109,11 +111,37 @@ def main(argv=None) -> int:
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
     cfg = build_config(
-        args, num_clients=args.num_clients, steps_per_round=args.steps_per_round
+        args,
+        # --cohort is the device-buffer size in population mode; it IS
+        # num_clients to everything downstream of the config.
+        num_clients=args.cohort or args.num_clients,
+        steps_per_round=args.steps_per_round,
     )
     if args.async_updates:
+        if cfg.fed.sim.population:
+            raise SystemExit(
+                "--population composes with synchronous rounds only "
+                "(the async FedBuff engine keeps per-client model copies — "
+                "inherently O(clients) device state)"
+            )
         return _run_async(args, cfg)
-    fed = Federation(cfg, seed=args.seed, mesh=_auto_mesh(args))
+    if cfg.fed.sim.population:
+        from fedtpu.sim import SimFederation
+
+        if _auto_mesh(args) is not None:
+            logging.warning(
+                "--population runs single-program for now; ignoring the "
+                "device mesh"
+            )
+        fed = SimFederation(cfg, seed=args.seed)
+        logging.info(
+            "sim population=%d cohort=%d scenario=%s sampler=%s "
+            "heterogeneity=%.3f",
+            cfg.fed.sim.population, cfg.fed.num_clients, fed.scenario_spec,
+            cfg.fed.sim.cohort_sampler, fed._hetero,
+        )
+    else:
+        fed = Federation(cfg, seed=args.seed, mesh=_auto_mesh(args))
 
     ckpt, start_round, state = _restore_from(args, like=fed.state)
     if state is not None:

@@ -165,6 +165,75 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Massive-cohort simulation (:mod:`fedtpu.sim`): decouple the simulated
+    **population** from the per-round **cohort**.
+
+    With ``population > 0`` the engine CLI runs a
+    :class:`fedtpu.sim.engine.SimFederation`: ``population`` clients exist
+    as lightweight host-side rows (dataset assignment, last-seen loss,
+    availability, sampling bookkeeping) while the device keeps only the
+    engine's fixed ``FedConfig.num_clients``-sized buffers — the cohort. A
+    seeded sampler draws each round's cohort and its rows are gathered into
+    those buffers, so device memory is O(cohort), not O(population)
+    (FedJAX-style, arXiv:2108.02117). ``population == num_clients`` with the
+    uniform sampler reproduces the resident engine bit-for-bit (test-pinned).
+    """
+
+    # 0 = off (resident engine: every client is a live device slot).
+    population: int = 0
+    # How each round's cohort is drawn from the available population:
+    # "uniform" (without replacement) | "loss" (proportional to last-seen
+    # training loss, optimistic prior for never-sampled clients).
+    cohort_sampler: str = "uniform"
+    # Scenario spec for the POPULATION partition (fedtpu.sim.scenario), e.g.
+    # "pathological:shards=2" or "dirichlet:alpha=0.1+quantity_skew:power=1.5".
+    # "" = use DataConfig.partition unchanged.
+    scenario: str = ""
+    # Optimistic loss prior for never-sampled clients under the "loss"
+    # sampler; < 0 = the max observed loss (the engine's existing fill rule).
+    loss_prior: float = -1.0
+    # Availability/churn trace (fedtpu.sim.population.Population): stationary
+    # up-fraction and per-round P(up -> down). availability=1, churn=0 =
+    # everyone always available.
+    availability: float = 1.0
+    churn: float = 0.0
+    # Extra sampler seed (folded with data.seed so two sim runs over the
+    # same data can draw different cohort sequences).
+    seed: int = 0
+
+
+def validate_sim_config(fed: "FedConfig") -> None:
+    """Raise on inconsistent sim settings (cheap, before any build work)."""
+    sim = fed.sim
+    if sim.population <= 0:
+        return
+    if sim.population < fed.num_clients:
+        raise ValueError(
+            f"sim.population={sim.population} < cohort "
+            f"(num_clients={fed.num_clients}); the cohort is drawn FROM the "
+            "population"
+        )
+    if sim.cohort_sampler not in ("uniform", "loss"):
+        raise ValueError(
+            f"unknown cohort_sampler {sim.cohort_sampler!r}; "
+            "have uniform | loss"
+        )
+    if fed.participation_fraction != 1.0:
+        raise ValueError(
+            "sim.population and participation_fraction are mutually "
+            "exclusive: the cohort sampler IS the participation model "
+            "(set participation_fraction=1.0)"
+        )
+    if not 0.0 < sim.availability <= 1.0:
+        raise ValueError(
+            f"sim.availability must be in (0, 1], got {sim.availability}"
+        )
+    if not 0.0 <= sim.churn <= 1.0:
+        raise ValueError(f"sim.churn must be in [0, 1], got {sim.churn}")
+
+
+@dataclasses.dataclass(frozen=True)
 class FedConfig:
     """Federated topology + algorithm."""
 
@@ -275,6 +344,10 @@ class FedConfig:
     ft_watchdog_timeout_s: float = 10.0
     ft_heartbeat_period_s: float = 1.0
     async_poll_s: float = 1.0
+    # Massive-cohort simulation (population >> cohort decoupling): see
+    # SimConfig / fedtpu.sim. num_clients doubles as the COHORT size when
+    # sim.population > 0 — the engine's device buffers stay that size.
+    sim: SimConfig = dataclasses.field(default_factory=SimConfig)
 
 
 def resolve_server_pipeline(fed: FedConfig) -> str:

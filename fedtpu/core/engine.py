@@ -59,12 +59,19 @@ class Federation:
         compressor: Optional["Compressor"] = None,
         data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         mesh=None,
+        assignment: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh`` over a ``clients``
         axis — rounds then run under ``shard_map`` with per-client state and
         data sharded across its devices and FedAvg as a psum over ICI
         (:mod:`fedtpu.parallel`). ``None`` keeps the single-program path
-        (one chip, or tests)."""
+        (one chip, or tests).
+
+        ``assignment``: an externally-built ``(idx, mask)`` client→example
+        map (``[num_clients, shard_len]``, the :mod:`fedtpu.data.partition`
+        convention) used instead of partitioning internally — the hook the
+        massive-cohort simulation layer (:mod:`fedtpu.sim`) uses to hand the
+        engine a cohort's rows gathered from a much larger population."""
         self.cfg = cfg
         self.mesh = mesh
         # Config validation FIRST — a bad flag must not cost a model build,
@@ -136,7 +143,14 @@ class Federation:
         self.images, self.labels = images, labels
 
         n = cfg.fed.num_clients
-        if cfg.data.partition == "round_robin":
+        if assignment is not None:
+            idx, mask = np.asarray(assignment[0]), np.asarray(assignment[1])
+            if idx.shape[0] != n or idx.shape != mask.shape:
+                raise ValueError(
+                    f"assignment must be [num_clients={n}, shard_len] "
+                    f"idx/mask pairs, got {idx.shape} vs {mask.shape}"
+                )
+        elif cfg.data.partition == "round_robin":
             idx, mask = partition.round_robin(len(images), n, cfg.data.batch_size)
         elif cfg.data.partition == "iid":
             idx, mask = partition.iid(len(images), n, seed=cfg.data.seed)
@@ -295,6 +309,47 @@ class Federation:
         return self._device_data
 
     # ---------------------------------------------------------------- data
+    def set_assignment(
+        self,
+        idx: np.ndarray,
+        mask: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ) -> None:
+        """Swap the client→example assignment in place (same shapes).
+
+        The sim layer's per-round cohort re-gather: the jitted data-round
+        program takes ``idx``/``mask`` as *inputs* of static shape, so
+        replacing their VALUES (a cohort-sized H2D of int32 rows) swaps
+        which population clients the fixed device slots represent without
+        recompiling. Gather layout only — the presharded layout bakes the
+        assignment into per-client data rows at upload, which would cost an
+        O(cohort·shard·features) re-preshard per round.
+        """
+        if self._layout != "gather":
+            raise ValueError(
+                "set_assignment requires device_layout='gather' (presharded "
+                "bakes the assignment into the uploaded data rows)"
+            )
+        idx = np.asarray(idx, np.int32)
+        mask = np.asarray(mask, bool)
+        if idx.shape != self.client_idx.shape or mask.shape != idx.shape:
+            raise ValueError(
+                f"assignment shape {idx.shape} must match the engine's "
+                f"{self.client_idx.shape} (static program shapes)"
+            )
+        self.client_idx, self.client_mask = idx, mask
+        w = partition.shard_sizes(mask) if weights is None else weights
+        self.weights = self._placed(np.asarray(w, np.float32),
+                                    sharded=self.mesh is not None)
+        if self._device_data is not None:
+            d_images, d_labels, _, _ = self._device_data
+            self._device_data = (
+                d_images,
+                d_labels,
+                self._placed(idx, sharded=True),
+                self._placed(mask, sharded=True),
+            )
+
     def _alive_for_round(self, round_idx: int) -> np.ndarray:
         """This round's participation mask: heartbeat-dead clients plus
         optional subsampling of the live ones (the reference always uses
@@ -334,14 +389,16 @@ class Federation:
                     loss_vec = multihost_utils.process_allgather(
                         loss_vec, tiled=True
                     )
-                obs = np.asarray(loss_vec)[live]
-                if not np.all(np.isnan(obs)):
-                    # Never-observed clients get the optimistic fill (the
-                    # max observed loss) so they are explored, not starved.
-                    fill = float(np.nanmax(obs))
-                    w = np.where(np.isnan(obs), fill, obs)
-                    w = np.maximum(w, 0.0) + 1e-8
-                    p = w / w.sum()
+                # Shared sparse-observation rule (fedtpu.sim.sampling):
+                # never-observed clients draw at the optimistic fill (max
+                # observed loss) so they are explored, not starved; None
+                # (nothing observed yet) falls back to uniform. The sim
+                # layer's population-scale cohort sampler routes through
+                # the SAME function, so both surfaces weigh sparse
+                # last-seen losses identically.
+                from fedtpu.sim.sampling import loss_weights
+
+                p = loss_weights(np.asarray(loss_vec)[live])
             keep = rng.choice(live, size=k, replace=False, p=p)
             alive = np.zeros_like(alive)
             alive[keep] = True

@@ -627,8 +627,14 @@ def make_round_step(
             round_idx=state.round_idx + 1,
             comp_state=comp_state,
             server_opt_state=new_server_opt,
+            # Observe only clients that actually TRAINED this round: an
+            # alive client with an empty shard runs zero steps and its
+            # out.loss is a masked artifact (0.0) — recording it would hand
+            # loss-proportional sampling a stale zero that starves the
+            # client forever. Never-trained clients keep NaN and draw at
+            # the optimistic prior instead (fedtpu.sim.sampling).
             last_client_loss=jnp.where(
-                batch.alive,
+                step_mask.any(axis=1),
                 out.loss.astype(jnp.float32),
                 state.last_client_loss,
             ),

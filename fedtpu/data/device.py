@@ -24,6 +24,14 @@ Two HBM layouts (``DataConfig.device_layout``):
 * ``"gather"``: dataset stays ``[N, features]``; per-round index gather.
   Exact per-round permutation shuffling and no 2x data HBM, at the measured
   gather cost. This is the exact semantics of the rounds-1-3 artifacts.
+  It is also what the massive-cohort simulation layer (:mod:`fedtpu.sim`)
+  requires: the assignment matrix stays a *program input* of static shape,
+  so swapping which population clients the cohort's device slots represent
+  is a values-only ``idx``/``mask`` replacement per round
+  (:meth:`fedtpu.core.engine.Federation.set_assignment`) — no recompile,
+  no re-upload of the dataset. Presharding would bake the assignment into
+  the uploaded per-client rows, costing an O(cohort·shard·features)
+  re-preshard every cohort change.
 
 The reference's analogue is its torch DataLoader re-iterated every epoch on
 the host (``src/main.py:140-144``); there is deliberately no counterpart to

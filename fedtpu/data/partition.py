@@ -61,35 +61,101 @@ def iid(
     return _pad_shards(shards)
 
 
+def _owner_to_shards(owner: np.ndarray, num_clients: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized shard build from an ``owner[example] = client`` map.
+
+    Replaces the per-class Python-list ``shards[c].extend(...)`` construction
+    (O(num_examples) list appends — measured seconds at a 10k-client
+    population) with one stable argsort + one scatter. Each client's row is
+    its example ids in ascending order, matching the ``sorted(s)``
+    normalisation of the list-based build bit-for-bit.
+    """
+    owner = np.asarray(owner, np.int64)
+    counts = np.bincount(owner, minlength=num_clients)
+    # Stable sort over example ids (which are already ascending) groups by
+    # client while keeping each group's ids ascending.
+    order = np.argsort(owner, kind="stable")
+    L = max(int(counts.max()), 1)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(owner)) - np.repeat(starts, counts)
+    idx = np.zeros((num_clients, L), dtype=np.int32)
+    mask = np.zeros((num_clients, L), dtype=bool)
+    idx[owner[order], pos] = order.astype(np.int32)
+    mask[owner[order], pos] = True
+    return idx, mask
+
+
 def dirichlet(
     labels: np.ndarray,
     num_clients: int,
     alpha: float = 0.5,
     seed: int = 0,
     min_size: int = 1,
+    min_size_action: str = "topup",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Label-skew non-IID split: per class, proportions ~ Dirichlet(alpha).
 
     Standard federated-learning benchmark partitioner (BASELINE config 2:
     "non-IID Dirichlet(0.5)"). Resamples until every client holds at least
-    ``min_size`` examples.
+    ``min_size`` examples — and, unlike the original implementation (which
+    silently returned under-``min_size`` clients after 100 failed resamples),
+    a persistent deficit is now *signalled*: with
+    ``min_size_action='topup'`` the deficient clients are deterministically
+    topped up from the largest clients (highest example ids move first) under
+    a ``warnings.warn``; ``'raise'`` raises instead. Draws that satisfy
+    ``min_size`` are bit-identical to the historical output (same RNG call
+    sequence, same assignment rule, ascending ids per client).
     """
+    if min_size_action not in ("topup", "raise"):
+        raise ValueError(
+            f"unknown min_size_action {min_size_action!r}; have topup | raise"
+        )
     labels = np.asarray(labels)
     num_classes = int(labels.max()) + 1
     rng = np.random.default_rng(seed)
+    owner = np.empty(len(labels), np.int64)
     for _ in range(100):
-        shards = [[] for _ in range(num_clients)]
         for k in range(num_classes):
             idx_k = np.where(labels == k)[0]
             rng.shuffle(idx_k)
             props = rng.dirichlet([alpha] * num_clients)
             cuts = (np.cumsum(props) * len(idx_k)).astype(int)[:-1]
-            for c, part in enumerate(np.split(idx_k, cuts)):
-                shards[c].extend(part.tolist())
-        if min(len(s) for s in shards) >= min_size:
+            # np.split(idx_k, cuts) gives client c the positions in
+            # [cuts[c-1], cuts[c]) — i.e. the count of cuts <= position.
+            owner[idx_k] = np.searchsorted(
+                cuts, np.arange(len(idx_k)), side="right"
+            )
+        counts = np.bincount(owner, minlength=num_clients)
+        if counts.min() >= min_size:
             break
-    shards = [np.asarray(sorted(s), dtype=np.int32) for s in shards]
-    return _pad_shards(shards)
+    counts = np.bincount(owner, minlength=num_clients)
+    if counts.min() < min_size:
+        deficit = int(np.sum(np.maximum(min_size - counts, 0)))
+        if min_size_action == "raise":
+            raise ValueError(
+                f"dirichlet(alpha={alpha}) could not satisfy "
+                f"min_size={min_size} after 100 resamples "
+                f"({int((counts < min_size).sum())} clients short by "
+                f"{deficit} examples total)"
+            )
+        import warnings
+
+        warnings.warn(
+            f"dirichlet(alpha={alpha}) left {int((counts < min_size).sum())} "
+            f"client(s) below min_size={min_size} after 100 resamples; "
+            f"deterministically topping up {deficit} example(s) from the "
+            "largest client(s)",
+            stacklevel=2,
+        )
+        for c in np.flatnonzero(counts < min_size):
+            while counts[c] < min_size:
+                donor = int(np.argmax(counts))
+                # Deterministic rule: the donor's highest example id moves.
+                moved = np.flatnonzero(owner == donor)[-1]
+                owner[moved] = c
+                counts[donor] -= 1
+                counts[c] += 1
+    return _owner_to_shards(owner, num_clients)
 
 
 def make_client_batches(

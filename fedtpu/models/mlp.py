@@ -28,3 +28,12 @@ class MLPModule(nn.Module):
 @register("mlp")
 def MLP(num_classes: int = 10, hidden: int = 256) -> nn.Module:
     return MLPModule(num_classes=num_classes, hidden=hidden)
+
+
+@register("mlp_tiny")
+def MLPTiny(num_classes: int = 10, hidden: int = 32) -> nn.Module:
+    """Deliberately small MLP for population-scale simulation benches: at
+    10k vmapped clients the per-seat state (momentum + param copies +
+    deltas) of even the 256-hidden MLP is tens of GB; this keeps a
+    10k-cohort round inside one host (bench.py --cohort-scale)."""
+    return MLPModule(num_classes=num_classes, hidden=hidden)

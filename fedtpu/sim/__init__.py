@@ -1,0 +1,37 @@
+"""fedtpu.sim — massive-cohort simulation (population/cohort decoupling).
+
+The FedJAX-style (arXiv:2108.02117) simulation layer: a host-resident
+:class:`Population` of ``N >> cohort`` clients, seeded per-round cohort
+samplers, a composable non-IID scenario matrix, and
+:class:`SimFederation`, which feeds sampled cohorts through the resident
+engine's unchanged fused programs with O(cohort) device memory. See
+``docs/SIMULATION.md``.
+"""
+
+from fedtpu.sim.engine import SimFederation
+from fedtpu.sim.population import Population
+from fedtpu.sim.samplers import (
+    CohortSampler,
+    LossProportionalSampler,
+    UniformSampler,
+    make_sampler,
+)
+from fedtpu.sim.sampling import loss_weights
+from fedtpu.sim.scenario import (
+    cohort_eval_indices,
+    make_partition,
+    parse_scenario,
+)
+
+__all__ = [
+    "SimFederation",
+    "Population",
+    "CohortSampler",
+    "UniformSampler",
+    "LossProportionalSampler",
+    "make_sampler",
+    "loss_weights",
+    "make_partition",
+    "parse_scenario",
+    "cohort_eval_indices",
+]

@@ -1,0 +1,284 @@
+"""SimFederation — the massive-cohort simulation engine.
+
+Runs ``population`` simulated clients through the resident engine's
+fixed-size device buffers: each round a seeded sampler
+(:mod:`fedtpu.sim.samplers`) draws a ``cohort`` (= ``FedConfig.num_clients``)
+from the :class:`~fedtpu.sim.population.Population`, the cohort's
+assignment rows are gathered into the engine's ``[cohort, shard_len]``
+inputs (:meth:`fedtpu.core.engine.Federation.set_assignment` — a values-only
+swap, no recompile), and the round runs through the UNCHANGED jitted
+round/fused-scan programs. Device memory is O(cohort): the only
+O(population) objects are host numpy tables.
+
+Slot semantics
+--------------
+A device slot is a *seat*, not a client. When a seat is handed to a
+different client than last round, its heavy per-seat state — optimizer
+momentum, compressor residuals, PRNG key — is **reset** (jitted, donated:
+one fused ``where`` over the seat axis), because a cross-device client
+starts each cohort appearance fresh; what persists per *client* lives in
+the Population (last-seen loss, availability, sampling bookkeeping). When
+``population == cohort`` under the uniform sampler the seat map is the
+identity every round, the reset fast-path never fires, and the sim engine
+is **bit-identical** to a plain :class:`Federation` with the same config
+(the parity pin in ``tests/test_sim.py``).
+
+Fused blocks (:meth:`run_on_device`) sample ONE cohort per block — the
+cohort is a program input, so re-sampling mid-scan would mean shipping
+``[rounds, cohort, shard_len]`` assignments; per-block sampling keeps the
+H2D O(cohort) and matches how cross-device systems amortise cohort setup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtpu.config import RoundConfig, validate_sim_config
+from fedtpu.core.engine import Federation
+from fedtpu.sim import scenario as scenario_lib
+from fedtpu.sim.population import Population
+from fedtpu.sim.samplers import make_sampler
+
+
+def _default_scenario(cfg: RoundConfig) -> str:
+    """Scenario spec when ``sim.scenario`` is empty: the existing
+    DataConfig partitioner, verbatim."""
+    if cfg.data.partition == "dirichlet":
+        return f"dirichlet:alpha={cfg.data.dirichlet_alpha}"
+    return cfg.data.partition  # iid | round_robin
+
+
+class SimFederation(Federation):
+    """Population/cohort-decoupled simulated federation (see module doc)."""
+
+    def __init__(
+        self,
+        cfg: RoundConfig,
+        seed: int = 0,
+        compressor=None,
+        data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    ):
+        validate_sim_config(cfg.fed)
+        sim = cfg.fed.sim
+        if sim.population <= 0:
+            raise ValueError(
+                "SimFederation needs FedConfig.sim.population > 0 "
+                "(use Federation for the resident path)"
+            )
+        # The per-round cohort re-gather swaps assignment VALUES into the
+        # jitted program; only the gather layout keeps the assignment as a
+        # program input (presharded bakes it into the uploaded data rows).
+        if cfg.data.device_layout != "gather":
+            cfg = dataclasses.replace(
+                cfg, data=dataclasses.replace(cfg.data, device_layout="gather")
+            )
+        if data is None:
+            from fedtpu.data import data_source, load
+
+            images, labels = load(
+                cfg.data.dataset, "train", seed=cfg.data.seed,
+                num=cfg.data.num_examples,
+            )
+            src = data_source(cfg.data.dataset, "train")
+        else:
+            images, labels = data
+            src = "caller"
+
+        spec = sim.scenario or _default_scenario(cfg)
+        pop_idx, pop_mask = scenario_lib.make_partition(
+            spec, labels, sim.population, seed=cfg.data.seed,
+            batch_size=cfg.data.batch_size,
+        )
+        self.population = Population(
+            pop_idx, pop_mask, seed=cfg.data.seed + sim.seed,
+            availability=sim.availability, churn=sim.churn,
+        )
+        self.scenario_spec = spec
+        self._sampler = make_sampler(
+            sim.cohort_sampler, seed=cfg.data.seed + sim.seed,
+            prior=None if sim.loss_prior < 0 else sim.loss_prior,
+        )
+        cohort = cfg.fed.num_clients
+        # Seat map BEFORE the first install: the round-0 cohort, drawn now
+        # so the engine's initial buffers are built over real rows.
+        ids0, alive0 = self._sampler.sample(self.population, 0, cohort)
+        super().__init__(
+            cfg, seed=seed, compressor=compressor, data=(images, labels),
+            assignment=self._cohort_assignment(ids0, alive0),
+        )
+        self._data_source = src  # not 'caller': we loaded it ourselves
+        self.alive = alive0.copy()
+        self._cohort_ids = ids0
+        self._slot_ids = np.where(alive0, ids0, -1)
+        self._cohort_round = 0  # round the current cohort was drawn for
+        self.population.mark_sampled(ids0[alive0], 0)
+        self._refresh_fn = None
+        self._fresh_key_base = None
+        self._hetero = self.population.heterogeneity_index(labels)
+        self._set_sim_gauges()
+
+    # ------------------------------------------------------------- installs
+    def _cohort_assignment(self, ids, alive):
+        """Cohort rows for the engine: padded-dead seats get an empty mask
+        (no data -> no steps) on top of the dead ``alive`` flag."""
+        idx, mask, _ = self.population.gather(ids)
+        return idx, mask & alive[:, None]
+
+    def _set_sim_gauges(self) -> None:
+        tel = self.telemetry
+        tel.gauge(
+            "fedtpu_sim_population",
+            "simulated population size (host-resident clients)",
+        ).set(self.population.size)
+        tel.gauge(
+            "fedtpu_sim_cohort_size",
+            "live clients in the current cohort (dead-padded seats excluded)",
+        ).set(int(self.alive.sum()))
+        tel.gauge(
+            "fedtpu_sim_heterogeneity_index",
+            "mean total-variation distance of client label distributions "
+            "from the population's (0 = IID)",
+        ).set(self._hetero)
+        tel.gauge(
+            "fedtpu_sim_never_sampled",
+            "population clients never yet drawn into a cohort",
+        ).set(self.population.never_sampled())
+
+    def _fresh_keys(self, ids: np.ndarray):
+        """Per-CLIENT PRNG keys for fresh seats: ``fold_in(base, client_id)``
+        — a client's stream is its identity, independent of which seat it
+        lands in (the round step folds the round index on top)."""
+        if self._fresh_key_base is None:
+            self._fresh_key_base = jax.random.PRNGKey(
+                (self.cfg.data.seed + self.cfg.fed.sim.seed) ^ 0x51B0D5
+            )
+        return jax.vmap(lambda i: jax.random.fold_in(self._fresh_key_base, i))(
+            np.asarray(ids, np.uint32)
+        )
+
+    def _refresh(self, fresh: np.ndarray, ids: np.ndarray) -> None:
+        """Reset the heavy per-seat state of reassigned seats (donated jit:
+        one fused where over the seat axis) and install the population's
+        last-seen losses as the engine-side observation vector."""
+        if self._refresh_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def refresh(state, fresh_m, new_rng, new_loss):
+                def reset(x):
+                    m = fresh_m.reshape((-1,) + (1,) * (x.ndim - 1))
+                    return jnp.where(m, jnp.zeros_like(x), x)
+
+                return state._replace(
+                    opt_state=jax.tree.map(reset, state.opt_state),
+                    comp_state=jax.tree.map(reset, state.comp_state),
+                    client_rng=jnp.where(
+                        fresh_m[:, None], new_rng, state.client_rng
+                    ),
+                    last_client_loss=new_loss,
+                )
+
+            self._refresh_fn = refresh
+        self._state = self._refresh_fn(
+            self._state,
+            jnp.asarray(fresh),
+            self._fresh_keys(ids),
+            jnp.asarray(
+                self.population.last_seen_loss[ids], jnp.float32
+            ),
+        )
+
+    def _install_cohort(self, round_idx: int) -> None:
+        """Draw + install the cohort for ``round_idx`` (no-op if already
+        installed for it — `step` inside `run` calls land here once)."""
+        if round_idx == self._cohort_round:
+            return
+        with self.telemetry.span("cohort_sample", round=round_idx):
+            ids, alive = self._sampler.sample(
+                self.population, round_idx, self.cfg.fed.num_clients
+            )
+            self.population.mark_sampled(ids[alive], round_idx)
+            slot_ids = np.where(alive, ids, -1)
+            fresh = slot_ids != self._slot_ids
+            self._cohort_ids, self._cohort_round = ids, round_idx
+            self.alive = alive.copy()
+            if fresh.any():
+                idx, mask = self._cohort_assignment(ids, alive)
+                _, _, w = self.population.gather(ids)
+                self.set_assignment(idx, mask, weights=w * alive)
+                self._refresh(fresh, ids)
+                self._slot_ids = slot_ids
+            # else: identity re-draw — state, assignment and weights are
+            # already exactly this cohort's (the population==cohort parity
+            # fast path: device state is left byte-for-byte untouched).
+        self._set_sim_gauges()
+
+    def _observe_back(self) -> None:
+        """Write the block's on-device loss observations into the
+        population table (finite values only — dead/padded seats keep
+        their previous observation or NaN)."""
+        losses = np.asarray(self._state.last_client_loss)
+        live = self.alive
+        self.population.observe_loss(self._cohort_ids[live], losses[live])
+
+    # --------------------------------------------------------------- rounds
+    def step(self, batch=None):
+        if batch is None:
+            self._install_cohort(self._round_number())
+        m = super().step(batch)
+        if batch is None:
+            self._observe_back()
+        return m
+
+    def run_on_device(self, num_rounds: int):
+        # ONE cohort per fused block (see module docstring).
+        self._install_cohort(self._round_number())
+        m = super().run_on_device(num_rounds)
+        self._observe_back()
+        return m
+
+    # ----------------------------------------------------------------- eval
+    def cohort_label_hist(self) -> np.ndarray:
+        """Training-label histogram of the current cohort's live shards."""
+        idx, mask, _ = self.population.gather(self._cohort_ids)
+        mask = mask & self.alive[:, None]
+        labels = np.asarray(self.labels)
+        picked = labels[idx[mask]] if mask.any() else np.zeros(0, np.int64)
+        return np.bincount(picked, minlength=int(labels.max()) + 1)
+
+    def evaluate_cohort(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        num: Optional[int] = None,
+        seed: int = 0,
+    ):
+        """Per-cohort eval slice: evaluate on a test subset whose label
+        mixture matches the CURRENT cohort's training mixture
+        (:func:`fedtpu.sim.scenario.cohort_eval_indices`) — under label or
+        quantity skew this measures the model on the slice of the task this
+        cohort represents, which the global test average hides."""
+        num = num or min(len(labels), 1000)
+        sel = scenario_lib.cohort_eval_indices(
+            labels, self.cohort_label_hist(), num,
+            seed=self.cfg.data.seed + seed,
+        )
+        return self.evaluate(
+            np.asarray(images)[sel], np.asarray(labels)[sel]
+        )
+
+    # ---------------------------------------------------------------- intro
+    def status_snapshot(self) -> dict:
+        snap = super().status_snapshot()
+        snap["sim"] = dict(
+            self.population.stats(),
+            cohort_round=self._cohort_round,
+            cohort_live=int(self.alive.sum()),
+            scenario=self.scenario_spec,
+            heterogeneity_index=round(self._hetero, 4),
+        )
+        return snap

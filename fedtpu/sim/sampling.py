@@ -1,0 +1,54 @@
+"""Shared sampling math for sparse loss observations.
+
+One implementation of "probability weights from a last-seen-loss table"
+serves every loss-proportional sampler in the framework: the engine's
+``participation_sampling='loss'`` subset draw (`fedtpu.core.engine.
+Federation._alive_for_round`) and the population-scale cohort sampler
+(:mod:`fedtpu.sim.samplers`). The table is *sparse by construction* —
+clients are observed only in rounds they actually train — so the rule for
+missing observations is load-bearing: a never-yet-sampled client must draw
+at an **optimistic prior** (the maximum observed loss by default), not at a
+stale zero, or a small first cohort permanently starves the rest of the
+population.
+
+Numpy-only (host-side sampling decisions); no jax import.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def loss_weights(
+    observed: np.ndarray, prior: Optional[float] = None
+) -> Optional[np.ndarray]:
+    """Normalised sampling probabilities from sparse loss observations.
+
+    ``observed``: last-seen training losses, ``NaN`` where a client has
+    never been observed. Returns ``None`` when *nothing* has been observed
+    yet (callers fall back to uniform), else a probability vector where
+    unobserved entries are filled with ``prior`` (default: the maximum
+    observed loss — optimistic exploration) and every entry gets a small
+    floor so an observed-at-zero client keeps a nonzero pick probability.
+
+    This is bit-for-bit the fill/floor/normalise rule the engine's
+    ``_alive_for_round`` applied inline before the sim subsystem existed,
+    so refactored callers draw identical masks for identical inputs.
+    """
+    obs = np.asarray(observed, np.float64)
+    if obs.size == 0 or np.all(np.isnan(obs)):
+        return None
+    fill = float(np.nanmax(obs)) if prior is None or prior < 0 else float(prior)
+    w = np.where(np.isnan(obs), fill, obs)
+    w = np.maximum(w, 0.0) + 1e-8
+    return w / w.sum()
+
+
+def round_rng(seed: int, round_idx: int, salt: int = 0) -> np.random.Generator:
+    """The framework's seeded per-round generator rule (`seed * 7919 +
+    round`), with an optional salt to decorrelate independent consumers
+    (e.g. the cohort sampler vs the availability trace) of the same round.
+    Centralised so every sampling surface derives draws the same way."""
+    return np.random.default_rng((seed + salt * 1_000_003) * 7919 + round_idx)

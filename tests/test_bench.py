@@ -298,6 +298,46 @@ def test_chaos_overhead_microbench_contract(bench, monkeypatch, tmp_path):
         assert json_mod.load(f) == result
 
 
+def test_cohort_scale_contract(bench, monkeypatch, tmp_path):
+    """--cohort-scale at a seconds-scale config: schema + artifact emission
+    and the two claims the acceptance criterion leans on — per-seat device
+    state grows with the cohort, and is byte-identical under a different
+    population (O(cohort), not O(population)). The 10k-clients-per-round
+    gate itself is pinned by the committed artifacts/COHORT_SCALE.json run.
+    """
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_CS_MODEL", "mlp_tiny")
+    monkeypatch.setenv("FEDTPU_CS_POPULATION", "256")
+    monkeypatch.setenv("FEDTPU_CS_COHORTS", "16,32")
+    monkeypatch.setenv("FEDTPU_CS_ROUNDS", "1")
+    monkeypatch.setenv("FEDTPU_CS_EXAMPLES", "1024")
+    result = bench._cohort_scale()
+    assert result["metric"] == "cohort_scale"
+    assert result["population"] == 256
+    assert result["value"] == 32  # largest cohort actually ran, fully live
+    assert [p["cohort"] for p in result["curve"]] == [16, 32]
+    for p in result["curve"]:
+        assert p["clients_per_round"] == p["cohort"]  # everyone available
+        assert p["round_s"] > 0 and p["clients_per_sec"] > 0
+        assert p["seat_state_bytes"] > 0 and p["host_table_bytes"] > 0
+        assert p["heterogeneity_index"] > 0  # the default scenario is skewed
+    a, b = result["curve"]
+    assert b["seat_state_bytes"] == 2 * a["seat_state_bytes"]  # O(cohort)
+    mm = result["memory_model"]
+    assert mm["o_cohort"] is True
+    assert (
+        mm["seat_state_bytes_full_population"]
+        == mm["seat_state_bytes_half_population"]
+    )
+    path = os.path.join(str(art), "COHORT_SCALE.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
 def test_telemetry_microbench_contract(bench, monkeypatch, tmp_path):
     """--telemetry-microbench at a seconds-scale config: schema, artifact
     emission, and a valid trace-check leg (the <1%-on-densenet acceptance

@@ -52,6 +52,25 @@ def main(argv=None) -> int:
     p.add_argument("--world", default=2, type=int,
                    help="total client count (for config only; actual world "
                    "arrives with each StartTrain)")
+    p.add_argument(
+        "--join", default=None, metavar="HOST:PORT",
+        help="announce this client to the coordinator's membership gate "
+        "(--gate on the server CLI) instead of requiring it in the "
+        "server's --clients list: sends Join(address) with retries until "
+        "admitted, after which the coordinator resyncs the global model "
+        "and samples this client into rounds (docs/FAULT_TOLERANCE.md)",
+    )
+    p.add_argument(
+        "--join-timeout", default=60.0, type=float, metavar="SECONDS",
+        help="give up announcing after this long (the gate may start "
+        "after the client; Join retries with backoff until then)",
+    )
+    p.add_argument(
+        "--leave-on-exit", action="store_true",
+        help="send Leave(address) to the --join gate on shutdown, so the "
+        "coordinator evicts this client (freeing its seat) instead of "
+        "probing a silent departure forever",
+    )
     args = p.parse_args(argv)
     apply_platform_flag(args)
 
@@ -74,9 +93,24 @@ def main(argv=None) -> int:
         status_fn=agent.status_snapshot, flight=flight,
     )
     logging.info("client agent serving on %s", args.address)
+    gate_stub = None
+    if args.join:
+        from fedtpu.transport import announce_join
+
+        gate_stub = announce_join(
+            args.join, args.address, timeout_s=args.join_timeout,
+        )
+        if gate_stub is None:
+            logging.error("never admitted by gate %s; serving anyway "
+                          "(the coordinator may still list us statically)",
+                          args.join)
     try:
         server.wait_for_termination()
     finally:
+        if args.leave_on_exit and gate_stub is not None:
+            from fedtpu.transport import announce_leave
+
+            announce_leave(gate_stub, args.address)
         if obs is not None:
             obs.stop()
     return 0

@@ -52,6 +52,16 @@ def main(argv=None) -> int:
     )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument(
+        "--gate", default=None, metavar="HOST:PORT",
+        help="host the membership gate on this address (primary role): a "
+        "gRPC listener answering Join/Leave, so clients can enter and "
+        "exit the federation at runtime instead of being frozen into "
+        "--clients at startup (docs/FAULT_TOLERANCE.md). Joiners are "
+        "admitted into the versioned MembershipTable, resynced with the "
+        "current global model, and sampled into rounds from then on; the "
+        "roster replicates to the backup every round",
+    )
+    p.add_argument(
         "--metrics", default=None,
         help="JSONL metrics path: one schema-versioned round record "
         "(fedtpu.obs.RoundRecordWriter) per round — participants, wire "
@@ -125,12 +135,21 @@ def main(argv=None) -> int:
         if args.checkpoint_dir:
             ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
             if args.resume:
-                # Full server state (model + round counter + FedOpt
-                # moments); legacy model-only checkpoints still restore,
-                # with the counter estimated from the checkpoint index.
+                # Full server state (model + round counter + membership +
+                # FedOpt moments); pre-membership checkpoints restore under
+                # the legacy template (keeping the startup roster), and
+                # legacy model-only checkpoints still restore with the
+                # counter estimated from the checkpoint index.
                 try:
                     latest = ckpt.restore_latest(primary.state_template())
                 except ValueError:
+                    try:
+                        latest = ckpt.restore_latest(
+                            primary.state_template(membership=False)
+                        )
+                    except ValueError:
+                        latest = None
+                if latest is None:
                     params, stats = _model_template(primary.model, cfg)
                     legacy = ckpt.restore_latest(
                         {"params": params, "batch_stats": stats}
@@ -167,6 +186,8 @@ def main(argv=None) -> int:
             status_fn=primary.status_snapshot,
             flight=flight,
         )
+        if args.gate:
+            primary.start_gate(args.gate)
 
         def on_round(r: int, rec: dict) -> None:
             if metrics is not None:
@@ -194,6 +215,7 @@ def main(argv=None) -> int:
                 )
         finally:
             flush()
+            primary.stop_gate()
             if obs is not None:
                 obs.stop()
         return 0

@@ -17,6 +17,7 @@ Plus the machinery that *proves* both under real gRPC:
 """
 
 from fedtpu.ft.heartbeat import ClientRegistry, HeartbeatMonitor
+from fedtpu.ft.membership import MembershipTable
 from fedtpu.ft.failover import (
     FailoverStateMachine,
     PrimaryPinger,
@@ -28,6 +29,7 @@ from fedtpu.ft.chaos import FaultRule, FaultSchedule, parse_spec as parse_chaos_
 __all__ = [
     "ClientRegistry",
     "HeartbeatMonitor",
+    "MembershipTable",
     "FailoverStateMachine",
     "PrimaryPinger",
     "Role",

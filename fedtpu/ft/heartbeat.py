@@ -10,6 +10,12 @@ engine consumes its mask as ``RoundBatch.alive``) plus a
 :class:`HeartbeatMonitor` whose probe/recover/clock hooks are injected, so
 the whole recovery loop is testable in-process with fake clients and a fake
 clock (the reference's only test was manually killing processes, SURVEY §4).
+
+Since the elastic-membership work the registry is a thin alias over
+:class:`fedtpu.ft.membership.MembershipTable` — the mutable, versioned
+roster that additionally supports admit/evict (dynamic join/leave) and
+tolerates operations on evicted members. A fixed-fleet deployment behaves
+exactly as before.
 """
 
 from __future__ import annotations
@@ -17,82 +23,30 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
-import numpy as np
+from fedtpu.ft.membership import MembershipTable
 
 log = logging.getLogger("fedtpu.ft")
 
 
-class ClientRegistry:
+class ClientRegistry(MembershipTable):
     """Thread-safe alive/dead registry keyed by client id.
 
     The reference keeps this as a bare dict mutated from three threads with
     no lock (``src/server.py:31,59-62,95-99``); we lock. Alive-state
     *transitions* (not redundant re-marks) are structured events: logged,
     and counted into ``metrics`` (a :class:`fedtpu.obs.MetricsRegistry`)
-    when one is attached — previously a client death changed state silently
-    and only surfaced if the caller happened to log around the call.
+    when one is attached.
+
+    This is the fixed-roster name for :class:`MembershipTable` — everything
+    (including dynamic admit/evict and the log-and-ignore handling of ids
+    that are not, or are no longer, members) lives in the base class. Each
+    client's rank (data shard) is its stable SEAT — a deliberate divergence
+    from the reference, which renumbers ranks among the currently-active
+    clients every round (``src/server.py:126-129``) and therefore silently
+    moves a client's shard whenever any peer dies.
     """
-
-    def __init__(self, clients: List[str],
-                 metrics: Optional[object] = None):
-        self._order = list(clients)
-        self._alive: Dict[str, bool] = {c: True for c in clients}
-        self._lock = threading.Lock()
-        self._metrics = metrics
-
-    @property
-    def clients(self) -> List[str]:
-        return list(self._order)
-
-    def mark_failed(self, client: str) -> None:
-        with self._lock:
-            was_alive = self._alive[client]
-            self._alive[client] = False
-        if was_alive:
-            log.warning("client %s marked dead", client)
-            if self._metrics is not None:
-                self._metrics.counter(
-                    "fedtpu_ft_client_deaths_total",
-                    "alive -> dead client transitions",
-                ).inc()
-
-    def mark_alive(self, client: str) -> None:
-        with self._lock:
-            was_alive = self._alive[client]
-            self._alive[client] = True
-        if not was_alive:
-            log.info("client %s recovered", client)
-            if self._metrics is not None:
-                self._metrics.counter(
-                    "fedtpu_ft_client_recoveries_total",
-                    "dead -> alive client transitions",
-                ).inc()
-
-    def is_alive(self, client: str) -> bool:
-        with self._lock:
-            return self._alive[client]
-
-    def dead_clients(self) -> List[str]:
-        with self._lock:
-            return [c for c in self._order if not self._alive[c]]
-
-    def active_clients(self) -> List[str]:
-        """Clients that participate this round, in registry order. Each
-        client's rank (data shard) is its stable REGISTRY index — a
-        deliberate divergence from the reference, which renumbers ranks
-        among the currently-active clients every round
-        (``src/server.py:126-129``) and therefore silently moves a client's
-        shard whenever any peer dies. Stable ranks match the simulated
-        engine's alive-mask semantics; ``world`` stays the total client
-        count in both designs."""
-        with self._lock:
-            return [c for c in self._order if self._alive[c]]
-
-    def alive_mask(self) -> np.ndarray:
-        with self._lock:
-            return np.array([self._alive[c] for c in self._order], bool)
 
 
 class HeartbeatMonitor:
@@ -102,6 +56,16 @@ class HeartbeatMonitor:
     (in production: a HeartBeat RPC and a SendModel push of the current
     global model — exactly the reference's ``checkClientStatus``,
     ``src/server.py:78-101``).
+
+    Probes of MULTIPLE dead clients run concurrently, each on its own
+    (daemon) thread, bounded by ``probe_deadline_s`` of wall clock per
+    tick: the old sequential pass let one hung probe — a blackholed peer
+    whose RPC only fails at its deadline — starve recovery of every other
+    dead client for ``deadline * retries`` per victim. A probe that
+    overruns the tick budget keeps running in the background and still
+    revives its client when it completes; it just stops blocking everyone
+    else's recovery. A single dead client is probed inline (no thread), so
+    fake-clock tests and the common one-victim case stay synchronous.
     """
 
     def __init__(
@@ -111,11 +75,13 @@ class HeartbeatMonitor:
         resync: Callable[[str], None],
         period: float = 1.0,
         metrics: Optional[object] = None,
+        probe_deadline_s: Optional[float] = None,
     ):
         self.registry = registry
         self.probe = probe
         self.resync = resync
         self.period = period
+        self.probe_deadline_s = probe_deadline_s
         self._metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -124,45 +90,77 @@ class HeartbeatMonitor:
         if self._metrics is not None:
             self._metrics.counter(name, help).inc()
 
-    def tick(self) -> List[str]:
-        """One probe pass; returns the clients recovered this pass.
-
-        Resync happens *before* the client is marked alive so a revived
-        client never receives a StartTrain ahead of the current global model
-        (the reference does the same: sendOptimizedModel, then
-        ``clients[client] = True``, ``src/server.py:95-99``).
-        """
-        recovered = []
-        for client in self.registry.dead_clients():
-            # Time the probe round-trip: these control-plane RPCs used to
-            # count misses but never their latency, and probe RTT inflation
-            # is the early-warning signal for a congested/flapping edge.
-            t0 = time.perf_counter()
-            up = self.probe(client)
-            if self._metrics is not None:
-                self._metrics.histogram(
-                    "fedtpu_ft_rpc_seconds",
-                    "FT control-plane RPC round-trip seconds by rpc",
-                    labels={"rpc": "HeartBeat"},
-                ).observe(time.perf_counter() - t0)
-            if up:
-                try:
-                    self.resync(client)
-                except Exception:
-                    # Still unreachable; retry next tick.
-                    self._count(
-                        "fedtpu_ft_resync_failures_total",
-                        "heartbeat succeeded but the resync push failed",
-                    )
-                    continue
-                self.registry.mark_alive(client)
-                recovered.append(client)
-            else:
+    def _probe_one(self, client: str, recovered: List[str],
+                   lock: threading.Lock) -> None:
+        """One probe + (on success) resync + revive. Resync happens
+        *before* the client is marked alive so a revived client never
+        receives a StartTrain ahead of the current global model (the
+        reference does the same: sendOptimizedModel, then
+        ``clients[client] = True``, ``src/server.py:95-99``)."""
+        # Time the probe round-trip: these control-plane RPCs used to
+        # count misses but never their latency, and probe RTT inflation
+        # is the early-warning signal for a congested/flapping edge.
+        t0 = time.perf_counter()
+        up = self.probe(client)
+        if self._metrics is not None:
+            self._metrics.histogram(
+                "fedtpu_ft_rpc_seconds",
+                "FT control-plane RPC round-trip seconds by rpc",
+                labels={"rpc": "HeartBeat"},
+            ).observe(time.perf_counter() - t0)
+        if up:
+            try:
+                self.resync(client)
+            except Exception:
+                # Still unreachable; retry next tick.
                 self._count(
-                    "fedtpu_ft_heartbeat_misses_total",
-                    "heartbeat probes of dead clients that stayed dead",
+                    "fedtpu_ft_resync_failures_total",
+                    "heartbeat succeeded but the resync push failed",
                 )
-        return recovered
+                return
+            self.registry.mark_alive(client)
+            with lock:
+                recovered.append(client)
+        else:
+            self._count(
+                "fedtpu_ft_heartbeat_misses_total",
+                "heartbeat probes of dead clients that stayed dead",
+            )
+
+    def tick(self) -> List[str]:
+        """One probe pass; returns the clients recovered within the pass
+        (seat order). With more than one dead client the probes run
+        concurrently and the pass is bounded by ``probe_deadline_s``."""
+        dead = self.registry.dead_clients()
+        recovered: List[str] = []
+        lock = threading.Lock()
+        if not dead:
+            return recovered
+        if len(dead) == 1:
+            self._probe_one(dead[0], recovered, lock)
+            return recovered
+        threads = [
+            threading.Thread(
+                target=self._probe_one, args=(c, recovered, lock),
+                daemon=True,
+            )
+            for c in dead
+        ]
+        for t in threads:
+            t.start()
+        deadline = (
+            None if self.probe_deadline_s is None
+            else time.monotonic() + self.probe_deadline_s
+        )
+        for t in threads:
+            t.join(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+        with lock:
+            done = list(recovered)
+        seat = {c: i for i, c in enumerate(self.registry.clients)}
+        return sorted(done, key=lambda c: seat.get(c, len(seat)))
 
     # ------------------------------------------------------- thread runner
     def start(self) -> None:

@@ -1,0 +1,302 @@
+"""Dynamic, versioned federation membership.
+
+The reference — and fedtpu's own :class:`~fedtpu.ft.heartbeat.ClientRegistry`
+until this module — freezes the client set at startup: a dead client can be
+*revived* but a new one can never be *admitted*, and a departed one never
+removed (reference registry: ``src/server.py:31,281-282``). Production
+federations churn: clients join mid-run, vanish silently, return stale, and
+the roster an operator sees must be the roster the round loop samples from.
+
+:class:`MembershipTable` makes membership a first-class, mutable, versioned
+state:
+
+- **Seats.** Every member holds a stable integer *seat* — its rank, i.e.
+  the data shard it trains (``fedtpu.transport.federation.LocalTrainer._shard``)
+  and its row in alive masks and round records. Seats of evicted members are
+  freed and handed to later joiners (lowest free seat first), so
+  :meth:`capacity` — the ``world`` every client partitions against — holds
+  steady under steady churn and only grows when the federation genuinely
+  outgrows it. This is the transport twin of the sim engine's fixed device
+  seats (:mod:`fedtpu.sim.engine`: dynamic client ids mapped onto a
+  fixed-size cohort via the values-only ``set_assignment`` swap).
+- **Epochs.** Every roster transition (admit / evict) bumps :meth:`version`,
+  the membership epoch. The epoch rides the replica payload to the backup
+  (:meth:`fedtpu.transport.federation.PrimaryServer.replica_bytes`), so a
+  promoted backup inherits the *current* roster, not the startup list.
+- **Events.** Transitions are structured: logged, and counted into
+  ``metrics`` (``fedtpu_membership_joins_total``,
+  ``fedtpu_membership_evictions_total{reason}``) with live
+  ``fedtpu_membership_size`` / ``fedtpu_membership_version`` gauges, like
+  the existing death/recovery counters.
+- **Tolerance.** ``mark_failed`` / ``mark_alive`` / ``is_alive`` on an id
+  that is not (or no longer) a member log-and-ignore instead of raising:
+  under dynamic membership a late RPC completion from an evicted client is
+  ordinary, and a bare ``KeyError`` would kill the collect worker thread
+  that reports it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("fedtpu.ft")
+
+
+class MembershipTable:
+    """Thread-safe, versioned, seat-stable membership roster.
+
+    ``clients`` seeds the initial members (all alive, seats in list order)
+    without logging or counting — construction is not churn. Later
+    :meth:`admit` calls add members *dead*: a joiner must be resynced with
+    the current global model before it may receive a StartTrain (the same
+    resync-before-revive order the heartbeat monitor enforces).
+    """
+
+    def __init__(self, clients: Iterable[str] = (),
+                 metrics: Optional[object] = None):
+        self._seat: Dict[str, int] = {}
+        self._alive: Dict[str, bool] = {}
+        self._free: List[int] = []  # freed seats, min-heap
+        self._capacity = 0
+        self._version = 0
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        for c in clients:
+            if c in self._seat:
+                raise ValueError(f"duplicate client id {c!r}")
+            self._seat[c] = self._capacity
+            self._alive[c] = True
+            self._capacity += 1
+
+    # ------------------------------------------------------------ metrics
+    def _count(self, name: str, help: str, labels=None) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(name, help, labels=labels).inc()
+
+    def _gauges(self) -> None:
+        """Refresh the size/version gauges (call OUTSIDE the lock)."""
+        if self._metrics is None:
+            return
+        self._metrics.gauge(
+            "fedtpu_membership_size",
+            "current federation members (alive + dead, evicted excluded)",
+        ).set(self.size)
+        self._metrics.gauge(
+            "fedtpu_membership_version",
+            "membership epoch: bumped by every admit/evict transition",
+        ).set(self.version)
+
+    def _unknown(self, op: str, client: str) -> None:
+        log.info("membership: %s for non-member %s ignored", op, client)
+        self._count(
+            "fedtpu_membership_unknown_total",
+            "registry operations for non-members, ignored (late RPCs from "
+            "evicted clients)",
+            labels={"op": op},
+        )
+
+    # ------------------------------------------------------ introspection
+    @property
+    def clients(self) -> List[str]:
+        """Current members in seat order (the rank/mask ordering)."""
+        with self._lock:
+            return sorted(self._seat, key=self._seat.__getitem__)
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._seat)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def capacity(self) -> int:
+        """The ``world`` clients partition against: seats ever allocated
+        (free seats included — they will be reused before it grows)."""
+        with self._lock:
+            return self._capacity
+
+    def is_member(self, client: str) -> bool:
+        with self._lock:
+            return client in self._seat
+
+    def seat_of(self, client: str) -> Optional[int]:
+        with self._lock:
+            return self._seat.get(client)
+
+    def seat_map(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._seat)
+
+    def active_clients(self) -> List[str]:
+        """Live members in seat order; each client's rank (data shard) is
+        its stable SEAT, never its position among the currently-live (the
+        reference renumbers ranks every round, ``src/server.py:126-129``,
+        silently moving shards whenever a peer dies)."""
+        with self._lock:
+            return sorted(
+                (c for c, a in self._alive.items() if a),
+                key=self._seat.__getitem__,
+            )
+
+    def dead_clients(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                (c for c, a in self._alive.items() if not a),
+                key=self._seat.__getitem__,
+            )
+
+    def alive_mask(self) -> np.ndarray:
+        """Alive flags over the current members in seat order."""
+        with self._lock:
+            order = sorted(self._seat, key=self._seat.__getitem__)
+            return np.array([self._alive[c] for c in order], bool)
+
+    # -------------------------------------------------------- transitions
+    def admit(self, client: str) -> int:
+        """Admit ``client`` (idempotent: an existing member keeps its seat).
+        New members start DEAD — the caller resyncs, then
+        :meth:`mark_alive` — and take the lowest free seat, growing
+        capacity only when none is free. Returns the member's seat."""
+        with self._lock:
+            seat = self._seat.get(client)
+            if seat is not None:
+                return seat
+            if self._free:
+                seat = heapq.heappop(self._free)
+            else:
+                seat = self._capacity
+                self._capacity += 1
+            self._seat[client] = seat
+            self._alive[client] = False
+            self._version += 1
+            version = self._version
+        log.info(
+            "membership v%d: admitted %s at seat %d (unsynced)",
+            version, client, seat,
+        )
+        self._count(
+            "fedtpu_membership_joins_total",
+            "members admitted into the federation (join RPCs + rejoins "
+            "after eviction; the startup roster is not counted)",
+        )
+        self._gauges()
+        return seat
+
+    def evict(self, client: str, reason: str = "leave") -> bool:
+        """Remove ``client`` from the roster, freeing its seat for reuse.
+        Returns False (logged, counted as unknown) for a non-member."""
+        with self._lock:
+            seat = self._seat.pop(client, None)
+            if seat is not None:
+                del self._alive[client]
+                heapq.heappush(self._free, seat)
+                self._version += 1
+                version = self._version
+        if seat is None:
+            self._unknown("evict", client)
+            return False
+        log.info(
+            "membership v%d: evicted %s from seat %d (%s)",
+            version, client, seat, reason,
+        )
+        self._count(
+            "fedtpu_membership_evictions_total",
+            "members removed from the federation, by reason",
+            labels={"reason": reason},
+        )
+        self._gauges()
+        return True
+
+    def mark_failed(self, client: str) -> None:
+        with self._lock:
+            was_alive = self._alive.get(client)
+            if was_alive is not None:
+                self._alive[client] = False
+        if was_alive is None:
+            self._unknown("mark_failed", client)
+            return
+        if was_alive:
+            log.warning("client %s marked dead", client)
+            self._count(
+                "fedtpu_ft_client_deaths_total",
+                "alive -> dead client transitions",
+            )
+
+    def mark_alive(self, client: str) -> None:
+        with self._lock:
+            was_alive = self._alive.get(client)
+            if was_alive is not None:
+                self._alive[client] = True
+        if was_alive is None:
+            self._unknown("mark_alive", client)
+            return
+        if not was_alive:
+            log.info("client %s recovered", client)
+            self._count(
+                "fedtpu_ft_client_recoveries_total",
+                "dead -> alive client transitions",
+            )
+
+    def is_alive(self, client: str) -> bool:
+        """False for non-members: a late probe of an evicted client reads
+        as dead, never as a crash."""
+        with self._lock:
+            return self._alive.get(client, False)
+
+    # -------------------------------------------------------- replication
+    def snapshot(self) -> dict:
+        """JSON-able roster state for the replica payload / checkpoints."""
+        with self._lock:
+            return {
+                "version": self._version,
+                "capacity": self._capacity,
+                "members": [
+                    [c, self._seat[c], bool(self._alive[c])]
+                    for c in sorted(self._seat, key=self._seat.__getitem__)
+                ],
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Adopt a replicated :meth:`snapshot` wholesale — the promoted
+        backup's roster IS the primary's last replicated roster (alive
+        flags included, so a silently-departed client is not re-probed as
+        if it were fresh). The local version never goes backwards."""
+        members = snap["members"]
+        seats = [int(s) for _, s, _ in members]
+        if len(set(seats)) != len(seats):
+            raise ValueError("membership snapshot has duplicate seats")
+        capacity = max([int(snap["capacity"])] + [s + 1 for s in seats])
+        with self._lock:
+            self._seat = {str(c): int(s) for c, s, _ in members}
+            self._alive = {str(c): bool(a) for c, _, a in members}
+            self._capacity = capacity
+            taken = set(self._seat.values())
+            self._free = [s for s in range(capacity) if s not in taken]
+            heapq.heapify(self._free)
+            self._version = max(self._version, int(snap["version"]))
+            version = self._version
+        log.info(
+            "membership v%d: restored roster (%d members, capacity %d)",
+            version, len(members), capacity,
+        )
+        self._gauges()
+
+    def status(self) -> dict:
+        """The ``/statusz`` membership block."""
+        with self._lock:
+            order = sorted(self._seat, key=self._seat.__getitem__)
+            return {
+                "version": self._version,
+                "size": len(self._seat),
+                "capacity": self._capacity,
+                "alive": [c for c in order if self._alive[c]],
+                "dead": [c for c in order if not self._alive[c]],
+            }

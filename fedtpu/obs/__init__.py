@@ -22,6 +22,7 @@ FT users never pay for a backend.
 
 from fedtpu.obs.flight import FlightRecorder
 from fedtpu.obs.http import ObsServer, StatusBoard
+from fedtpu.obs.proc import process_fd_count, process_rss_bytes
 
 from fedtpu.obs.exporters import (
     SCHEMA_VERSION,
@@ -50,6 +51,8 @@ __all__ = [
     "FlightRecorder",
     "ObsServer",
     "StatusBoard",
+    "process_fd_count",
+    "process_rss_bytes",
     "SCHEMA_VERSION",
     "RoundRecordWriter",
     "parse_prometheus_text",

@@ -1,0 +1,44 @@
+"""Process-level resource observations for the leak gauges.
+
+The long-haul soaks (``tools/chaos_soak.py --churn``) assert a FLAT memory
+profile over a thousand rounds of continuous churn — which needs a gauge of
+the process's *current* resident set, sampled per round. ``getrusage``'s
+``ru_maxrss`` cannot serve: it is a high-water mark, monotone by
+definition, so a leak check against it would never see a plateau. On Linux
+the authoritative current value is ``VmRSS`` in ``/proc/self/status``;
+elsewhere we fall back to the high-water mark (better than nothing, and the
+soaks run on Linux).
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import sys
+
+
+def process_rss_bytes() -> int:
+    """Current resident-set size of this process in bytes (best effort:
+    0 when no source is readable)."""
+    try:
+        with open("/proc/self/status", encoding="ascii", errors="replace") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024  # kB
+    except OSError:
+        pass
+    try:
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports kB, macOS bytes — only reached off-Linux.
+        return peak if sys.platform == "darwin" else peak * 1024
+    except Exception:
+        return 0
+
+
+def process_fd_count() -> int:
+    """Open file descriptors (a second leak axis: channels/sockets under
+    churn). 0 when /proc is unavailable."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0

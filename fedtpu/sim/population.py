@@ -94,14 +94,77 @@ class Population:
             else np.ones((n,), bool)
         )
         self._avail_round = -1
+        # Membership mask: True = current member. The availability trace
+        # models TRANSIENT presence (a member that happens to be offline);
+        # membership models the roster itself — evicted clients are never
+        # sampled however their availability trace rolls, and mid-run
+        # admits (`admit`) grow the population without touching the
+        # engine's fixed cohort seats (the set_assignment values-only swap
+        # maps whatever ids the sampler draws onto them).
+        self._member = np.ones((n,), bool)
+
+    # ---------------------------------------------------------- membership
+    def admit(self, idx_row: np.ndarray, mask_row: np.ndarray) -> int:
+        """Admit a NEW client mid-run: append its padded dataset-assignment
+        row (same ``shard_len`` as the population's; shorter rows are
+        zero-padded) and fresh bookkeeping. Returns the new client id —
+        immediately eligible for cohort sampling."""
+        shard_len = self.idx.shape[1]
+        idx_row = np.asarray(idx_row, np.int32).reshape(-1)
+        mask_row = np.asarray(mask_row, bool).reshape(-1)
+        if idx_row.shape != mask_row.shape:
+            raise ValueError("admit: idx/mask rows must match")
+        if len(idx_row) > shard_len:
+            raise ValueError(
+                f"admit: shard of {len(idx_row)} exceeds the population's "
+                f"shard_len {shard_len}"
+            )
+        pad = shard_len - len(idx_row)
+        if pad:
+            idx_row = np.concatenate([idx_row, np.zeros((pad,), np.int32)])
+            mask_row = np.concatenate([mask_row, np.zeros((pad,), bool)])
+        cid = self.size
+        self.idx = np.concatenate([self.idx, idx_row[None]])
+        self.mask = np.concatenate([self.mask, mask_row[None]])
+        self.sizes = np.concatenate(
+            [self.sizes, [int(mask_row.sum())]]
+        ).astype(np.int64)
+        self.last_seen_loss = np.concatenate(
+            [self.last_seen_loss, [np.nan]]
+        ).astype(np.float32)
+        self.last_sampled_round = np.concatenate(
+            [self.last_sampled_round, [-1]]
+        ).astype(np.int64)
+        self.times_sampled = np.concatenate(
+            [self.times_sampled, [0]]
+        ).astype(np.int64)
+        self._avail = np.concatenate([self._avail, [True]])
+        self._member = np.concatenate([self._member, [True]])
+        self.size += 1
+        return cid
+
+    def evict(self, client_id: int) -> None:
+        """Remove a client from the roster (its row and bookkeeping stay,
+        so a later :meth:`readmit` returns it stale — with its last-seen
+        loss — rather than fresh)."""
+        self._member[int(client_id)] = False
+
+    def readmit(self, client_id: int) -> None:
+        """A stale rejoin: the client re-enters the roster with the
+        bookkeeping it left with."""
+        self._member[int(client_id)] = True
+
+    def members(self) -> np.ndarray:
+        return self._member.copy()
 
     # ------------------------------------------------------------ sampling
     def available_at(self, round_idx: int) -> np.ndarray:
         """The ``[population]`` availability mask for a round (advancing the
-        Markov trace as needed; rounds may only move forward)."""
+        Markov trace as needed; rounds may only move forward). Non-members
+        are never available, whatever their trace state."""
         if self.churn <= 0.0:
             # No dynamics: the initial draw holds at every round.
-            return self._avail.copy()
+            return self._avail & self._member
         if round_idx < self._avail_round:
             raise ValueError(
                 f"availability trace cannot rewind: at round "
@@ -116,7 +179,7 @@ class Population:
             rng = round_rng(self.seed, self._avail_round, salt=_AVAIL_SALT)
             u = rng.random(self.size)
             self._avail = np.where(self._avail, u >= c, u < p_up)
-        return self._avail.copy()
+        return self._avail & self._member
 
     def mark_sampled(self, client_ids: np.ndarray, round_idx: int) -> None:
         ids = np.asarray(client_ids, np.int64)
@@ -183,6 +246,7 @@ class Population:
         """Snapshot for status boards / artifacts."""
         return {
             "population": self.size,
+            "members": int(self._member.sum()),
             "shard_len": int(self.idx.shape[1]),
             "examples": int(self.sizes.sum()),
             "min_shard": int(self.sizes.min()),

@@ -15,6 +15,8 @@ from fedtpu.transport.service import (
     TrainerServicer,
     TrainerStub,
     add_trainer_servicer,
+    announce_join,
+    announce_leave,
     create_channel,
     create_server,
     probe,
@@ -28,6 +30,8 @@ __all__ = [
     "TrainerServicer",
     "TrainerStub",
     "add_trainer_servicer",
+    "announce_join",
+    "announce_leave",
     "create_channel",
     "create_server",
     "probe",

@@ -24,6 +24,7 @@ genuinely separate processes/hosts federating over a network edge.
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import os
@@ -50,10 +51,16 @@ from fedtpu.ft import (
     ClientRegistry,
     FailoverStateMachine,
     HeartbeatMonitor,
+    MembershipTable,
     PrimaryPinger,
     WatchdogRunner,
 )
-from fedtpu.obs import FlightRecorder, StatusBoard, Telemetry
+from fedtpu.obs import (
+    FlightRecorder,
+    StatusBoard,
+    Telemetry,
+    process_rss_bytes,
+)
 from fedtpu.obs import propagate
 from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
@@ -498,24 +505,28 @@ class PrimaryServer:
         # replays earlier rounds' PRNG draws. len(self.history) cannot
         # serve: history restarts at 0 in every new server process.
         self._round_counter = 0
-        if initial_model is not None:
-            self._install(initial_model)
 
         _metrics = self.telemetry.registry if self.telemetry.enabled else None
         if chaos is not None:
             chaos.attach(metrics=_metrics, flight=self.flight)
-        self.registry = ClientRegistry(clients, metrics=_metrics)
+        # The mutable, versioned roster (fedtpu.ft.membership): `clients`
+        # is only the STARTUP roster — members join/leave at runtime
+        # through the membership gate (start_gate / admit_client /
+        # remove_client), and a replica payload installed below may replace
+        # the roster wholesale with the previous primary's current one.
+        self.registry = MembershipTable(clients, metrics=_metrics)
         # Every outbound channel (StartTrain/SendModel fan-out, heartbeat
         # probes, backup pings/replication/FetchModel) carries the
         # trace-propagation interceptor; _trace_source yields None below
         # trace mode, so the interceptor is a single no-op call then. The
         # chaos interceptor (when armed) wraps outermost, keyed by peer.
+        # Guarded by _member_lock: the gate's admit/evict mutates this dict
+        # while collect workers read it.
+        self._member_lock = threading.Lock()
         self._stubs: Dict[str, TrainerStub] = {
-            c: TrainerStub(create_channel(
-                c, compress=compress, trace_source=self._trace_source,
-                chaos=chaos))
-            for c in clients
+            c: self._make_stub(c) for c in clients
         }
+        self._gate_server = None
         self.backup_stub = (
             TrainerStub(create_channel(
                 backup_address, compress=compress,
@@ -525,13 +536,16 @@ class PrimaryServer:
         )
         self.monitor = HeartbeatMonitor(
             self.registry,
-            probe=lambda c: probe(
-                self._stubs[c], timeout=self._deadlines["HeartBeat"],
-                policy=rp, telemetry=self.telemetry,
-            ) is not None,
+            probe=self._probe_member,
             resync=self._resync,
             period=cfg.fed.ft_heartbeat_period_s,
             metrics=_metrics,
+            # Concurrent probes are bounded per tick by the worst-case
+            # single probe: per-attempt deadline plus the backoff budget.
+            probe_deadline_s=(
+                rp.max_attempts
+                * (rp.probe_timeout_s + rp.backoff_max_s) + 1.0
+            ),
         )
         self.pinger = (
             PrimaryPinger(self._ping_backup, metrics=_metrics)
@@ -571,6 +585,11 @@ class PrimaryServer:
         # tracked like _inflight so next round's send to the same client
         # cannot race a stale one and install an older model last.
         self._sends: Dict[str, threading.Thread] = {}
+        # Install the seed state LAST: a replica payload carries the
+        # previous primary's membership roster, and adopting it needs the
+        # registry and stub plumbing above to exist.
+        if initial_model is not None:
+            self._install(initial_model)
 
     # ----------------------------------------------------------- aggregation
     def _aggregate_impl(
@@ -686,21 +705,26 @@ class PrimaryServer:
 
     def state_tree(self) -> dict:
         """Full resumable server state as one pytree: the model, the
-        monotonic round counter, and (when a server optimizer is configured)
-        its moments. This is both the replica payload body and the
-        checkpoint state — one format, so failover and resume can never
-        drift apart."""
+        monotonic round counter, the membership roster (as a JSON uint8
+        leaf — variable-length, so a growing federation still replicates),
+        and (when a server optimizer is configured) its moments. This is
+        both the replica payload body and the checkpoint state — one
+        format, so failover and resume can never drift apart."""
         tree = {
             "params": self.params,
             "batch_stats": self.batch_stats,
             "round_counter": np.asarray(self._round_counter, np.int64),
+            "membership": self._membership_bytes(),
         }
         if self._server_opt is not None:
             tree["server_opt"] = self._server_opt_state
         return tree
 
-    def state_template(self) -> dict:
-        """Decode template matching :meth:`state_tree`'s structure."""
+    def state_template(self, membership: bool = True) -> dict:
+        """Decode template matching :meth:`state_tree`'s structure.
+        ``membership=False`` yields the pre-elastic-membership layout, so
+        replicas/checkpoints written by older coordinators still restore
+        (with the startup roster kept)."""
         from fedtpu.core import server_opt as server_opt_lib
 
         params, stats = _model_template(self.model, self.cfg)
@@ -709,12 +733,17 @@ class PrimaryServer:
             "batch_stats": stats,
             "round_counter": np.zeros((), np.int64),
         }
+        if membership:
+            tree["membership"] = np.zeros((0,), np.uint8)
         if self._server_opt is not None:
             tree["server_opt"] = server_opt_lib.init(self.cfg.fed, params)
         return tree
 
     def install_state(self, tree: dict) -> None:
-        """Adopt a restored :meth:`state_tree` (from replica or checkpoint)."""
+        """Adopt a restored :meth:`state_tree` (from replica or checkpoint).
+        When the tree carries a membership roster, the CURRENT roster — not
+        the startup list — is adopted with it (failover inherits joins,
+        leaves, and alive flags)."""
         self._round_counter = int(tree["round_counter"])
         if self._server_opt is not None:
             self._server_opt_state = jax.tree.map(
@@ -722,6 +751,8 @@ class PrimaryServer:
             )
         self.params = jax.tree.map(jnp.asarray, tree["params"])
         self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+        if "membership" in tree:
+            self._adopt_membership(tree["membership"])
 
     def replica_bytes(self) -> bytes:
         """Backup-replication payload: the model plus (when a server
@@ -746,12 +777,22 @@ class PrimaryServer:
                 tree = wire.decode(data, self.state_template())
             except wire.WireError:
                 raise
-            except ValueError as exc:
-                raise wire.WireError(
-                    "replica payload does not match this server's "
-                    f"configuration ({exc}); refusing to install a partial "
-                    "state"
-                ) from exc
+            except ValueError:
+                # Pre-membership replica (an older coordinator's): decode
+                # under the legacy layout and keep the startup roster. Any
+                # OTHER mismatch fails this template too and raises below.
+                try:
+                    tree = wire.decode(
+                        data, self.state_template(membership=False)
+                    )
+                except wire.WireError:
+                    raise
+                except ValueError as exc:
+                    raise wire.WireError(
+                        "replica payload does not match this server's "
+                        f"configuration ({exc}); refusing to install a "
+                        "partial state"
+                    ) from exc
             self.install_state(tree)
         else:
             params, stats = _model_template(self.model, self.cfg)
@@ -783,11 +824,14 @@ class PrimaryServer:
                 f"stale broadcast to {client} still in flight; "
                 "deferring resync"
             )
+        stub = self._stub(client)
+        if stub is None:
+            raise RuntimeError(f"{client} evicted; nothing to resync")
         # A transient blip mid-resync retries here instead of bouncing the
         # client back to dead for another full heartbeat cycle.
         call_with_retry(
             self.retry_policy, "SendModel",
-            lambda: self._stubs[client].SendModel(
+            lambda: stub.SendModel(
                 proto.SendModelRequest(model=self.model_bytes()),
                 timeout=self._deadlines["SendModel"],
             ),
@@ -805,10 +849,13 @@ class PrimaryServer:
         """
         payload = self.model_bytes()
         for client in self.registry.active_clients():
+            stub = self._stub(client)
+            if stub is None:
+                continue  # evicted since active_clients() snapshot
             try:
                 call_with_retry(
                     self.retry_policy, "SendModel",
-                    lambda c=client: self._stubs[c].SendModel(
+                    lambda s=stub: s.SendModel(
                         proto.SendModelRequest(model=payload),
                         timeout=self._deadlines["SendModel"],
                     ),
@@ -864,6 +911,126 @@ class PrimaryServer:
                 )
         return resp.value
 
+    # ------------------------------------------------------------ membership
+    def _make_stub(self, address: str) -> TrainerStub:
+        return TrainerStub(create_channel(
+            address, compress=self.compress,
+            trace_source=self._trace_source, chaos=self.chaos,
+        ))
+
+    def _stub(self, client: str) -> Optional[TrainerStub]:
+        """The member's stub, or None for an (already-evicted) non-member —
+        collect/broadcast workers treat None as an ordinary failure."""
+        with self._member_lock:
+            return self._stubs.get(client)
+
+    def _probe_member(self, client: str) -> bool:
+        stub = self._stub(client)
+        if stub is None:
+            return False  # evicted between dead_clients() and the probe
+        return probe(
+            stub, timeout=self._deadlines["HeartBeat"],
+            policy=self.retry_policy, telemetry=self.telemetry,
+        ) is not None
+
+    def admit_client(self, address: str) -> dict:
+        """Admit (or re-admit) a member — the Join RPC's implementation.
+
+        The joiner is admitted DEAD and resynced through the same
+        model-push path a heartbeat revival uses (:meth:`_resync` →
+        ``sync_clients`` semantics): a stale joiner — fresh process, or a
+        returning client whose weights predate many rounds — must hold the
+        CURRENT global model before its first StartTrain, or in
+        sparse-delta mode its first delta would silently corrupt the
+        aggregate. If the inline resync fails the member stays dead and
+        the heartbeat monitor finishes the revival on a later tick; the
+        join itself still succeeded.
+        """
+        with self._member_lock:
+            rejoin = self.registry.is_member(address)
+            seat = self.registry.admit(address)
+            if address not in self._stubs:
+                self._stubs[address] = self._make_stub(address)
+        resynced = False
+        try:
+            self._resync(address)
+            self.registry.mark_alive(address)
+            resynced = True
+        except (grpc.RpcError, RuntimeError) as exc:
+            log.warning(
+                "join: %s admitted at seat %d but resync failed (%s); "
+                "heartbeat monitor will revive it", address, seat, exc,
+            )
+        self.flight.record(
+            "membership", event="join", client=address, seat=seat,
+            version=self.registry.version, rejoin=rejoin,
+        )
+        return {
+            "admitted": True,
+            "seat": seat,
+            "world": self.registry.capacity(),
+            "version": self.registry.version,
+            "resynced": resynced,
+        }
+
+    def remove_client(self, address: str, reason: str = "leave") -> dict:
+        """Evict a member (graceful Leave, or operator action): frees its
+        seat for later joiners and closes its channel. A late RPC from the
+        evicted client is ignored by the tolerant registry."""
+        left = self.registry.evict(address, reason=reason)
+        with self._member_lock:
+            stub = self._stubs.pop(address, None)
+        if stub is not None:
+            try:
+                stub._channel.close()
+            except Exception:
+                pass  # a late in-flight RPC owns the channel a bit longer
+        if left:
+            self.flight.record(
+                "membership", event="leave", client=address,
+                version=self.registry.version, reason=reason,
+            )
+        return {"left": left, "version": self.registry.version}
+
+    def _membership_bytes(self) -> np.ndarray:
+        """The roster snapshot as a uint8 JSON leaf for the replica/
+        checkpoint pytree (flax msgpack carries variable-length arrays)."""
+        blob = json.dumps(self.registry.snapshot()).encode()
+        return np.frombuffer(blob, np.uint8)
+
+    def _adopt_membership(self, leaf) -> None:
+        """Adopt a replicated roster (inverse of :meth:`_membership_bytes`)
+        and rebuild the stub table to match — a promoted backup then dials
+        the CURRENT fleet, not the startup list it was constructed with."""
+        blob = np.asarray(leaf, np.uint8).tobytes()
+        if not blob:
+            return  # template placeholder / membership-less checkpoint
+        self.registry.restore(json.loads(blob.decode()))
+        members = set(self.registry.clients)
+        with self._member_lock:
+            for address in members - set(self._stubs):
+                self._stubs[address] = self._make_stub(address)
+            for address in set(self._stubs) - members:
+                self._stubs.pop(address)
+
+    def start_gate(self, address: str):
+        """Host the membership gate — a gRPC server answering Join/Leave on
+        ``address`` (``--gate`` on the server CLI). The coordinator
+        otherwise only DIALS OUT; this is its sole inbound surface, so the
+        round loop never competes with admissions for a listener."""
+        gate = _MembershipGate(self)
+        self._gate_server = create_server(
+            address, gate, compress=self.compress, chaos=self.chaos
+        )
+        self._gate_server.start()
+        log.info("membership gate serving on %s", address)
+        return self._gate_server
+
+    def stop_gate(self) -> None:
+        if self._gate_server is not None:
+            self._gate_server.stop(0)
+            self._gate_server = None
+
     # ---------------------------------------------------------- observability
     def _trace_source(self) -> Optional[propagate.TraceContext]:
         """Per-RPC propagation context (runs on the issuing thread, so the
@@ -891,6 +1058,19 @@ class PrimaryServer:
             clients={
                 "alive": reg.active_clients(),
                 "dead": reg.dead_clients(),
+            },
+            # The full membership block: epoch/size/capacity + roster —
+            # what a churn soak (or an operator watching tools/statusz.py)
+            # audits joins and evictions against.
+            membership=reg.status(),
+            # Leak axes (also exported as gauges): current RSS and the
+            # last round's flat collect-buffer footprint.
+            mem={
+                "rss_bytes": process_rss_bytes(),
+                "buffer_bytes": (
+                    int(self.history[-1].get("buffer_bytes", 0))
+                    if self.history else 0
+                ),
             },
             stragglers_in_flight=sorted(
                 c for c, t in self._inflight.items() if t.is_alive()
@@ -935,6 +1115,20 @@ class PrimaryServer:
         with tel.span("round", round=self._round_counter) as rspan:
             rec = self._round_body(rspan)
         self.status.update(phase="idle")
+        if tel.enabled:
+            # Leak axes for the long-haul soaks (docs/OBSERVABILITY.md):
+            # flat over a healthy 1k-round churn soak, monotone growth is
+            # the failure signature. Sampled once per round — a /proc read
+            # is microseconds against a round.
+            tel.gauge(
+                "fedtpu_process_rss_bytes",
+                "current resident set size of this process",
+            ).set(process_rss_bytes())
+            tel.gauge(
+                "fedtpu_buffer_bytes",
+                "flat collect-buffer bytes held by the last round "
+                "(host rows + device twin; 0 on the barrier path)",
+            ).set(rec.get("buffer_bytes", 0))
         if rec.get("aborted"):
             # Sub-quorum abort: the abort already logged its own flight
             # event and counter inside _round_body; it is NOT a completed
@@ -982,7 +1176,17 @@ class PrimaryServer:
             self.chaos.set_round(self._round_counter)
         if not self._did_initial_sync:
             self.sync_clients()
+        # Roster snapshot for this round: cohort selection runs over the
+        # LIVE set of the CURRENT membership; a join/leave landing mid-round
+        # takes effect next round.
         active = self.registry.active_clients()
+        members_now = self.registry.size
+        membership_version = self.registry.version
+        # The round record's alive mask spans THIS snapshot's roster — a
+        # mid-round admit would otherwise tear the record (mask longer
+        # than `world`). Alive state itself is read at record time, so a
+        # member dying mid-round (retry exhaustion) still shows.
+        roster_now = self.registry.clients
         # Random client subsampling (engine parity: _alive_for_round; the
         # reference always uses every live client). Sampled-out clients skip
         # this round's StartTrain but still receive the broadcast.
@@ -998,7 +1202,11 @@ class PrimaryServer:
             active = sorted(
                 rng.choice(np.asarray(active), size=k, replace=False).tolist()
             )
-        world = len(self.registry.clients)
+        # Partition width = SEAT capacity (freed seats included): stable
+        # under steady churn — a joiner reuses an evicted member's seat, so
+        # every other client's shard stays put — and grows only when the
+        # roster genuinely outgrows it.
+        world = self.registry.capacity()
         # Host copies of the global model are only needed for dense replies /
         # sparse templates; build them lazily (in topk steady state the full
         # device->host transfer would otherwise run every round for nothing).
@@ -1057,7 +1265,7 @@ class PrimaryServer:
         dev_buf: List[Any] = []
         stream_lock = threading.Lock()
 
-        def train_one(rank: int, client: str) -> None:
+        def train_one(rank: int, client: str, stub: TrainerStub) -> None:
             # Runs on a collect worker thread: the client span parents to
             # this round's span EXPLICITLY (thread-local nesting cannot
             # cross threads); decode/h2d spans below nest under it via the
@@ -1069,7 +1277,7 @@ class PrimaryServer:
                 # — reject-and-retry, never "silently lose the client's
                 # round" (the pre-policy behavior: the worker thread died
                 # with the exception and the reply just vanished).
-                reply = self._stubs[client].StartTrain(
+                reply = stub.StartTrain(
                     proto.TrainRequest(rank=rank, world=world),
                     timeout=self._deadlines["StartTrain"],
                 )
@@ -1203,18 +1411,26 @@ class PrimaryServer:
                     "sparse mode: broadcast still in flight, baselines "
                     "stale, sitting out: %s", unsynced,
                 )
-        launch = [
-            c for c in active if c not in still_busy and c not in unsynced
-        ]
-        # Each client trains its OWN registry-order shard, regardless of
-        # which clients were sampled or skipped this round: rank is the
-        # client's stable registry index, not its position in the launch
-        # list. Positional ranks would retrain shards 0..k-1 every round
-        # under participation sampling (shards k.. never trained) and move
-        # a client's shard between rounds — breaking engine parity (the
+        # Stub snapshot for the launch (under the member lock): an eviction
+        # landing after this point still completes the already-launched
+        # RPC on the old channel; one landing before it drops the client
+        # from the launch list.
+        with self._member_lock:
+            stub_of = dict(self._stubs)
+        # Each client trains its OWN seat's shard, regardless of which
+        # clients were sampled or skipped this round: rank is the client's
+        # stable membership SEAT, not its position in the launch list.
+        # Positional ranks would retrain shards 0..k-1 every round under
+        # participation sampling (shards k.. never trained) and move a
+        # client's shard between rounds — breaking engine parity (the
         # engine's alive-mask semantics) and run_async, which already
-        # assigns registry-order ranks.
-        rank_of = {c: i for i, c in enumerate(self.registry.clients)}
+        # assigns seat ranks.
+        rank_of = self.registry.seat_map()
+        launch = [
+            c for c in active
+            if c not in still_busy and c not in unsynced
+            and c in stub_of and c in rank_of
+        ]
         if stream and launch:
             row_of.update({c: i for i, c in enumerate(launch)})
             padded = self._flat_layout.padded
@@ -1224,7 +1440,8 @@ class PrimaryServer:
         with tel.span("collect", launched=len(launch)):
             threads = {
                 client: threading.Thread(
-                    target=train_one, args=(rank_of[client], client)
+                    target=train_one,
+                    args=(rank_of[client], client, stub_of[client]),
                 )
                 for client in launch
             }
@@ -1279,16 +1496,24 @@ class PrimaryServer:
         # delta must be computed against the server's global, not that
         # drift.
         quorum = cfg.fed.round_quorum
-        needed = max(1, math.ceil(quorum * len(active))) if quorum > 0 else 0
+        # Quorum counts against the CURRENT membership (post join/evict),
+        # never the startup roster: a federation where half the members
+        # are dead-but-not-evicted must abort rather than quietly commit
+        # with the survivors, and EVICTING the departed (shrinking the
+        # denominator) is the operator's way to move on. Under
+        # participation sampling (frac < 1) the sampled subset is the
+        # round's electorate, so the base stays the sampled count.
+        quorum_base = len(active) if frac < 1.0 else members_now
+        needed = max(1, math.ceil(quorum * quorum_base)) if quorum > 0 else 0
         if needed and len(completed) < needed:
             with stream_lock:
                 dev_buf.clear()  # close the stream buffer; rows discarded
             self._did_initial_sync = False
             log.warning(
                 "round %d aborted: %d/%d replies below quorum %.2f of %d "
-                "sampled clients; global model untouched, will re-run",
+                "members; global model untouched, will re-run",
                 self._round_counter, len(completed), needed, quorum,
-                len(active),
+                quorum_base,
             )
             tel.counter(
                 "fedtpu_round_aborts_total",
@@ -1299,10 +1524,12 @@ class PrimaryServer:
                 participants=len(completed), quorum_needed=needed,
             )
             rec = {
+                "round": self._round_counter,
                 "participants": len(completed),
                 "stragglers": len(stragglers),
                 "world": world,
-                "alive": self.registry.alive_mask().tolist(),
+                "alive": [self.registry.is_alive(c) for c in roster_now],
+                "membership_version": membership_version,
                 "aborted": True,
                 "quorum_needed": needed,
                 "bytes_up": int(bytes_up.value),
@@ -1406,11 +1633,14 @@ class PrimaryServer:
                 ).inc()
 
         def send_one(client: str) -> None:
+            stub = self._stub(client)
+            if stub is None:
+                return  # evicted since the broadcast list was drawn
             try:
                 with tel.span("broadcast", parent=rspan.id, client=client):
                     call_with_retry(
                         self.retry_policy, "SendModel",
-                        lambda: self._stubs[client].SendModel(
+                        lambda: stub.SendModel(
                             proto.SendModelRequest(model=payload),
                             timeout=self._deadlines["SendModel"],
                         ),
@@ -1467,10 +1697,22 @@ class PrimaryServer:
         }
 
         rec = {
+            # The LINEAGE round index (monotone across failovers and
+            # rolling upgrades — the replica carries the counter), vs
+            # "step", each generation's local 0-based count. The churn
+            # soak's monotone-counter gate reads this field.
+            "round": self._round_counter - 1,
             "participants": len(completed),
             "stragglers": len(stragglers),
             "world": world,
-            "alive": self.registry.alive_mask().tolist(),
+            "alive": [self.registry.is_alive(c) for c in roster_now],
+            "membership_version": membership_version,
+            # Flat-buffer footprint of this round's streaming collect (host
+            # rows + the device twin; 0 on the barrier path) — with
+            # process RSS, the leak axes the long-haul soaks watch.
+            "buffer_bytes": (
+                2 * int(host_rows[0].nbytes) if stream and host_rows else 0
+            ),
             # Wire accounting (successful transfers only) — the reference
             # can't report this at all; its payloads are opaque base64 blobs
             # (src/client.py:21).
@@ -1577,12 +1819,15 @@ class PrimaryServer:
                 if not self.registry.is_alive(client):
                     time.sleep(0.2)  # heartbeat monitor may revive it
                     continue
+                stub = self._stub(client)
+                if stub is None:
+                    return  # evicted mid-run: this worker retires
                 try:
                     with version_lock:
                         base_version, payload, base = current[0]
                     call_with_retry(
                         self.retry_policy, "SendModel",
-                        lambda: self._stubs[client].SendModel(
+                        lambda: stub.SendModel(
                             proto.SendModelRequest(model=payload),
                             timeout=self._deadlines["SendModel"],
                         ),
@@ -1597,12 +1842,12 @@ class PrimaryServer:
                         # RPC + decode as one retryable unit: a corrupt
                         # reply (WireError) is re-requested like any
                         # transient (see round()'s train_one).
-                        reply = self._stubs[client].StartTrain(
+                        reply = stub.StartTrain(
                             proto.TrainRequest(
-                                # Each client keeps its OWN registry-order
-                                # shard; the synchronous path assigns the
-                                # same stable ranks (see round()'s rank_of).
-                                rank=rank, world=len(self.registry.clients)
+                                # Each client keeps its OWN seat's shard;
+                                # the synchronous path assigns the same
+                                # stable seat ranks (see round()'s rank_of).
+                                rank=rank, world=self.registry.capacity()
                             ),
                             timeout=self._deadlines["StartTrain"],
                         )
@@ -1652,9 +1897,12 @@ class PrimaryServer:
         if self.pinger is not None:
             self.pinger.tick()
             self.pinger.start()
+        # One worker per member AT START; members admitted mid-run are
+        # replicated/heartbeat-managed but only join the training loop on
+        # the next run_async invocation (documented in FAULT_TOLERANCE.md).
         workers = [
             threading.Thread(target=worker, args=(c, rank), daemon=True)
-            for rank, c in enumerate(self.registry.clients)
+            for c, rank in sorted(self.registry.seat_map().items())
         ]
         for w in workers:
             w.start()
@@ -1674,12 +1922,13 @@ class PrimaryServer:
 
         poll_s = fed.async_poll_s
         # Async quorum (cfg.fed.round_quorum): an update only applies while
-        # at least that fraction of the REGISTRY is alive — below it the
+        # at least that fraction of the CURRENT membership (not the startup
+        # roster — members join and leave) is alive — below it the
         # buffered deltas are held (global untouched) until the heartbeat
         # monitor revives enough clients, the async analogue of the
         # synchronous round abort. 0 = apply whenever buffer_k arrive.
         quorum_n = (
-            max(1, math.ceil(fed.round_quorum * len(self.registry.clients)))
+            max(1, math.ceil(fed.round_quorum * self.registry.size))
             if fed.round_quorum > 0 else 0
         )
         try:
@@ -1880,6 +2129,40 @@ class PrimaryServer:
         return self.history
 
 
+# ----------------------------------------------------------------------- gate
+class _MembershipGate(TrainerServicer):
+    """The coordinator's inbound membership surface: Join admits the
+    caller's advertised serving address into the primary's
+    :class:`~fedtpu.ft.membership.MembershipTable` (and resyncs it with the
+    current global model through the heartbeat-revival path), Leave evicts
+    it gracefully. Hosted by :meth:`PrimaryServer.start_gate`; all other
+    RPCs stay UNIMPLEMENTED — the gate is not a Trainer."""
+
+    def __init__(self, primary: "PrimaryServer"):
+        self.primary = primary
+
+    def Join(self, request: proto.JoinRequest, context) -> proto.JoinReply:
+        address = request.address.decode()
+        if not address:
+            return proto.JoinReply(admitted=0, message=b"empty address")
+        out = self.primary.admit_client(address)
+        return proto.JoinReply(
+            admitted=1, seat=out["seat"], world=out["world"],
+            version=out["version"],
+            message=b"resynced" if out["resynced"] else b"pending resync",
+        )
+
+    def Leave(self, request: proto.LeaveRequest, context) -> proto.LeaveReply:
+        address = request.address.decode()
+        out = self.primary.remove_client(address, reason="leave")
+        return proto.LeaveReply(
+            left=1 if out["left"] else 0, version=out["version"]
+        )
+
+    def HeartBeat(self, request: proto.Request, context) -> proto.HeartBeatResponse:
+        return proto.HeartBeatResponse(status=1)
+
+
 # --------------------------------------------------------------------- backup
 class BackupServer(TrainerServicer):
     """Backup-side servicer + failover driver (parity:
@@ -1897,7 +2180,12 @@ class BackupServer(TrainerServicer):
         round_deadline_s: Optional[float] = None,
         flight: Optional[FlightRecorder] = None,
         chaos=None,
+        on_acting_round: Optional[Callable[[int, dict], None]] = None,
     ):
+        """``on_acting_round(r, record)``: forwarded to the acting
+        primary's round loop after a promotion — the hook rolling-upgrade
+        and churn drills use to keep their per-round bookkeeping (round
+        records, scripted churn) running across the failover window."""
         self.cfg = cfg
         self.clients = clients
         self.compress = compress
@@ -1905,6 +2193,7 @@ class BackupServer(TrainerServicer):
         # mitigation (and fault injection) survive failover.
         self.round_deadline_s = round_deadline_s
         self.chaos = chaos
+        self.on_acting_round = on_acting_round
         if watchdog_timeout is None:
             watchdog_timeout = cfg.fed.ft_watchdog_timeout_s
         log.info(
@@ -1959,6 +2248,27 @@ class BackupServer(TrainerServicer):
         if acting is not None and acting.history:
             return proto.SendModelRequest(model=acting.replica_bytes())
         return proto.SendModelRequest(model=self.latest_model or b"")
+
+    def Join(self, request: proto.JoinRequest, context) -> proto.JoinReply:
+        """Membership during a failover window: the backup's address is the
+        STABLE join target — while it is acting primary, joins land in the
+        acting coordinator's roster (and replicate back to the recovered
+        primary through the state tree); in the backup role it refuses,
+        pointing the joiner back at the primary's gate."""
+        from fedtpu.ft import Role
+
+        acting = self.acting
+        if self.machine.role is Role.ACTING_PRIMARY and acting is not None:
+            return _MembershipGate(acting).Join(request, context)
+        return proto.JoinReply(admitted=0, message=b"not primary")
+
+    def Leave(self, request: proto.LeaveRequest, context) -> proto.LeaveReply:
+        from fedtpu.ft import Role
+
+        acting = self.acting
+        if self.machine.role is Role.ACTING_PRIMARY and acting is not None:
+            return _MembershipGate(acting).Leave(request, context)
+        return proto.LeaveReply(left=0)
 
     def status_snapshot(self) -> dict:
         """``/statusz`` feed for the backup role: failover state + (when
@@ -2016,7 +2326,8 @@ class BackupServer(TrainerServicer):
         self.acting = acting
 
         def run_acting():
-            acting.run(stop=stop_event.is_set)
+            acting.run(stop=stop_event.is_set,
+                       on_round=self.on_acting_round)
             # Whatever the acting primary trained becomes the replication
             # state, so a later re-promotion (or FetchModel from the
             # recovered primary) starts from its progress, not from the

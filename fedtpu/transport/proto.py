@@ -217,3 +217,80 @@ class PingResponse:
     @classmethod
     def decode(cls, data: bytes) -> "PingResponse":
         return cls(value=_int32(_decode_fields(data).get(1, 0)))
+
+
+# Elastic-membership extension beyond the reference's 8 messages (the
+# reference freezes its registry at startup, src/server.py:281-282). A
+# joiner announces the address it SERVES on — the coordinator dials
+# clients, so the address is the member identity — and learns its seat
+# (rank / data shard), the world (partition width) and the membership
+# epoch. Leave is the graceful counterpart; silent departures are handled
+# by the heartbeat machinery instead.
+@dataclasses.dataclass
+class JoinRequest:
+    address: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.address)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "JoinRequest":
+        return cls(address=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class JoinReply:
+    admitted: int = 0
+    seat: int = 0
+    world: int = 0
+    version: int = 0
+    message: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([
+            (1, _VARINT, self.admitted),
+            (2, _VARINT, self.seat),
+            (3, _VARINT, self.world),
+            (4, _VARINT, self.version),
+            (5, _LEN, self.message),
+        ])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "JoinReply":
+        f = _decode_fields(data)
+        return cls(
+            admitted=_int32(f.get(1, 0)),
+            seat=_int32(f.get(2, 0)),
+            world=_int32(f.get(3, 0)),
+            version=_int32(f.get(4, 0)),
+            message=f.get(5, b""),
+        )
+
+
+@dataclasses.dataclass
+class LeaveRequest:
+    address: bytes = b""
+
+    def encode(self) -> bytes:
+        return _encode_fields([(1, _LEN, self.address)])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LeaveRequest":
+        return cls(address=_decode_fields(data).get(1, b""))
+
+
+@dataclasses.dataclass
+class LeaveReply:
+    left: int = 0
+    version: int = 0
+
+    def encode(self) -> bytes:
+        return _encode_fields([
+            (1, _VARINT, self.left),
+            (2, _VARINT, self.version),
+        ])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LeaveReply":
+        f = _decode_fields(data)
+        return cls(left=_int32(f.get(1, 0)), version=_int32(f.get(2, 0)))

@@ -44,6 +44,13 @@ _METHODS = {
     # primary restarts from its own stale files). Unknown methods don't
     # affect interop on the original 4.
     "FetchModel": (proto.Request, proto.SendModelRequest),
+    # Elastic membership (docs/FAULT_TOLERANCE.md): a client announces the
+    # address it serves on and is admitted into (Join) or removed from
+    # (Leave) the coordinator's MembershipTable. Served by the primary's
+    # membership gate and by the backup (which delegates to its acting
+    # primary after a failover, so joiners keep working mid-outage).
+    "Join": (proto.JoinRequest, proto.JoinReply),
+    "Leave": (proto.LeaveRequest, proto.LeaveReply),
 }
 
 
@@ -52,6 +59,9 @@ class TrainerStub:
     (reference ``src/federated_pb2_grpc.py:8-36``)."""
 
     def __init__(self, channel: grpc.Channel):
+        # Kept for lifecycle management: dynamic membership closes a
+        # member's channel on eviction instead of leaking it.
+        self._channel = channel
         for name, (req_t, resp_t) in _METHODS.items():
             setattr(
                 self,
@@ -85,6 +95,14 @@ class TrainerServicer:
         raise NotImplementedError
 
     def FetchModel(self, request: proto.Request, context) -> proto.SendModelRequest:
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        raise NotImplementedError
+
+    def Join(self, request: proto.JoinRequest, context) -> proto.JoinReply:
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        raise NotImplementedError
+
+    def Leave(self, request: proto.LeaveRequest, context) -> proto.LeaveReply:
         context.set_code(grpc.StatusCode.UNIMPLEMENTED)
         raise NotImplementedError
 
@@ -165,6 +183,57 @@ def create_server(
     add_trainer_servicer(servicer, server)
     server.add_insecure_port(address)
     return server
+
+
+def announce_join(
+    gate_address: str, my_address: str, timeout_s: float = 60.0,
+    poll_s: float = 0.5,
+) -> Optional[TrainerStub]:
+    """Client-side half of dynamic membership: announce ``my_address`` (the
+    address this client SERVES on — its member identity) to the
+    coordinator's membership gate, retrying with a flat backoff until
+    admitted or ``timeout_s`` elapses. The gate may come up after the
+    client (a rolling restart), so refusal and unreachability both just
+    wait. Returns the gate stub (reusable for :func:`announce_leave`) on
+    admission, None on timeout."""
+    import logging
+    import time
+
+    stub = TrainerStub(create_channel(gate_address))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            reply = stub.Join(
+                proto.JoinRequest(address=my_address.encode()), timeout=5.0
+            )
+            if reply.admitted:
+                logging.info(
+                    "admitted by gate %s: seat=%d world=%d membership v%d "
+                    "(%s)", gate_address, reply.seat, reply.world,
+                    reply.version, reply.message.decode(errors="replace"),
+                )
+                return stub
+        except grpc.RpcError as exc:
+            logging.info("gate %s not ready (%s); retrying",
+                         gate_address, exc.code())
+        time.sleep(poll_s)
+    return None
+
+
+def announce_leave(stub: TrainerStub, my_address: str) -> bool:
+    """Graceful departure: best-effort Leave against an
+    :func:`announce_join` gate stub (False when the gate is unreachable —
+    the heartbeat machinery then handles us as a silent leaver)."""
+    import logging
+
+    try:
+        reply = stub.Leave(
+            proto.LeaveRequest(address=my_address.encode()), timeout=5.0
+        )
+        return bool(reply.left)
+    except grpc.RpcError as exc:
+        logging.warning("Leave failed (%s); departing silently", exc.code())
+        return False
 
 
 def probe(

@@ -373,3 +373,75 @@ def test_telemetry_microbench_contract(bench, monkeypatch, tmp_path):
     path = os.path.join(str(art), "TELEMETRY_MICROBENCH.json")
     with open(path) as f:
         assert json_mod.load(f) == result
+
+
+def _committed_artifact(name):
+    import json as json_mod
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", name)
+    assert os.path.exists(path), f"committed artifact {name} missing"
+    with open(path) as f:
+        return json_mod.load(f)
+
+
+def test_churn_soak_artifact_contract():
+    """Schema + gate contract of the committed 1k-round churn-soak
+    artifact (tools/chaos_soak.py --churn): the elastic-membership PR's
+    acceptance evidence. The soak itself re-runs as a `slow` test
+    (tests/test_membership.py); this pins what it must have proven."""
+    result = _committed_artifact("CHURN_SOAK.json")
+    assert result["ok"] is True
+    cfg = result["config"]
+    assert cfg["rounds"] >= 1000
+    assert 0 < cfg["upgrade_round"] < cfg["rounds"]
+    # Monotone lineage: every round committed exactly once across the
+    # three coordinator generations.
+    lineage = result["lineage"]
+    assert lineage["committed"] == cfg["rounds"]
+    assert lineage["strictly_monotone"] and lineage["exact_cover"]
+    gens = result["generations"]
+    assert gens["gen1"] == cfg["upgrade_round"]
+    assert gens["acting"] >= 1 and gens["gen2"] >= 1
+    assert sum(gens.values()) == cfg["rounds"]
+    # Zero transient deaths: every observed death is a scheduled silent
+    # leave; the chaos layer injected + the retry layer absorbed.
+    obs = result["observed"]
+    assert obs["client_deaths"] == result["expected_silent_deaths"]
+    assert obs["chaos_injected"] > 0 and obs["rpc_retries"] > 0
+    assert obs["round_aborts"] == 0
+    # Churn actually churned, through the real Join/Leave RPCs.
+    sched = result["scheduled"]
+    assert min(sched["join"], sched["silent_leave"],
+               sched["stale_rejoin"], sched["leave"], sched["rejoin"]) > 0
+    assert obs["membership_joins"] == sched["join"] + sched["rejoin"]
+    assert obs["membership_evictions"] == sched["leave"]
+    # Zero lost rounds across the upgrade: bit-identical to the
+    # unupgraded control, per-client round counts equal.
+    assert result["bit_identical_vs_control"] is True
+    counts = result["client_round_counts"]
+    assert counts["control"] == counts["upgraded"]
+    # Flat memory profile from the /statusz RSS gauge.
+    mem = result["memory"]
+    assert mem["settled_samples"] >= 8
+    assert mem["growth_pct"] < 8.0
+    assert mem["gate"].endswith("(enforced)")
+
+
+def test_rolling_upgrade_artifact_contract():
+    """Schema contract of the committed rolling-upgrade drill artifact
+    (tools/rolling_upgrade.py): zero-loss + bit-identical handover."""
+    result = _committed_artifact("ROLLING_UPGRADE.json")
+    assert result["ok"] is True
+    cfg = result["config"]
+    lineage = result["lineage"]
+    assert lineage["committed"] == cfg["rounds"]
+    assert lineage["strictly_monotone"] and lineage["exact_cover"]
+    gens = result["generations"]
+    assert gens["gen1"] == cfg["upgrade_round"] and gens["acting"] >= 1
+    assert result["bit_identical"] is True
+    counts = result["client_round_counts"]
+    assert counts["control"] == counts["upgraded"]
+    # The mid-run joiner is in the final roster (one more than startup).
+    assert result["roster"]["upgraded"]["size"] == cfg["clients"] + 1

@@ -1,0 +1,386 @@
+"""Elastic membership: the MembershipTable, Join/Leave over real gRPC,
+quorum-over-live-set semantics, membership replication through failover,
+and the rolling-upgrade / churn drills.
+
+Fast legs run in tier-1 (a few seconds of real gRPC on localhost); the
+1k-round churn soak runs as ``slow``.
+"""
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from fedtpu.config import RetryPolicy
+from fedtpu.ft import MembershipTable
+from fedtpu.ft.heartbeat import HeartbeatMonitor
+from fedtpu.transport import proto
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import chaos_soak  # noqa: E402
+import rolling_upgrade  # noqa: E402
+
+
+# ------------------------------------------------------- membership table
+def test_admit_evict_seats_and_versions():
+    t = MembershipTable(["a", "b"])
+    assert t.clients == ["a", "b"]
+    assert t.capacity() == 2 and t.version == 0  # startup roster: no churn
+    # New members start DEAD (must be resynced before StartTrain) and take
+    # fresh seats.
+    assert t.admit("c") == 2
+    assert not t.is_alive("c")
+    assert t.capacity() == 3 and t.version == 1
+    t.mark_alive("c")
+    # Eviction frees the seat; the next joiner reuses it (lowest first),
+    # so capacity — the `world` clients partition against — holds steady.
+    assert t.evict("b", reason="leave")
+    assert t.clients == ["a", "c"] and t.version == 2
+    assert t.admit("d") == 1
+    assert t.capacity() == 3 and t.version == 3
+    assert t.seat_of("d") == 1 and t.seat_of("c") == 2
+    # Idempotent admit keeps the seat and does not bump the epoch.
+    assert t.admit("d") == 1 and t.version == 3
+    # Masks/orderings are seat-ordered over CURRENT members.
+    t.mark_alive("d")
+    np.testing.assert_array_equal(t.alive_mask(), [True, True, True])
+    assert t.clients == ["a", "d", "c"]
+
+
+def test_unknown_ids_are_logged_and_ignored():
+    """A late RPC completion from an evicted client lands in mark_failed /
+    mark_alive on an unknown id — that must log-and-ignore, never raise
+    (a bare KeyError here killed the collect worker thread)."""
+    t = MembershipTable(["a"])
+    t.admit("b")
+    t.evict("b")
+    t.mark_failed("b")   # no raise
+    t.mark_alive("b")    # no raise
+    assert t.is_alive("b") is False
+    assert not t.evict("b")  # double-evict: reported, not raised
+    assert t.is_member("b") is False
+
+
+def test_snapshot_restore_roundtrip_preserves_alive_and_seats():
+    t = MembershipTable(["a", "b", "c"])
+    t.mark_failed("b")
+    t.evict("c", reason="leave")
+    t.admit("d")
+    snap = t.snapshot()
+    fresh = MembershipTable(["x", "y"])  # promoted backup's startup list
+    fresh.restore(snap)
+    assert fresh.clients == t.clients
+    assert fresh.seat_map() == t.seat_map()
+    assert not fresh.is_alive("b")      # dead flags replicate
+    assert not fresh.is_member("c")
+    assert fresh.capacity() == t.capacity()
+    # Seat allocation continues correctly after the restore ("d" already
+    # reused c's freed seat, so "e" must grow capacity, not collide).
+    assert fresh.admit("e") == 3
+    assert fresh.version >= snap["version"]
+
+
+def test_concurrent_admit_evict_revive_races():
+    """Hammer one table from many threads; invariants that must hold
+    whatever the interleaving: unique seats, capacity >= live seats,
+    monotone version, no exceptions."""
+    t = MembershipTable([f"s{i}" for i in range(4)])
+    stop = time.monotonic() + 1.5
+    errors = []
+
+    def worker(k):
+        i = 0
+        try:
+            while time.monotonic() < stop:
+                cid = f"w{k}-{i % 7}"
+                t.admit(cid)
+                t.mark_alive(cid)
+                t.mark_failed(cid)
+                if i % 3 == 0:
+                    t.evict(cid)
+                t.is_alive(f"w{(k + 1) % 6}-{i % 7}")
+                t.alive_mask()
+                i += 1
+        except Exception as exc:  # pragma: no cover - the failure signal
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(6)]
+    for th in threads:
+        th.start()
+    versions = []
+    while time.monotonic() < stop:
+        versions.append(t.version)
+        snap = t.snapshot()
+        seats = [s for _, s, _ in snap["members"]]
+        assert len(set(seats)) == len(seats), "duplicate seats"
+        assert max(seats, default=-1) < snap["capacity"]
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    assert versions == sorted(versions), "membership version went backwards"
+    # And the final state is internally consistent + restorable.
+    fresh = MembershipTable([])
+    fresh.restore(t.snapshot())
+    assert fresh.clients == t.clients
+
+
+def test_heartbeat_probes_run_concurrently_and_bounded():
+    """One hung probe must not starve the other dead clients' recovery
+    (the old sequential pass blocked on each in turn), and the tick is
+    bounded by probe_deadline_s."""
+    t = MembershipTable(["slow", "fast"])
+    t.mark_failed("slow")
+    t.mark_failed("fast")
+    release = threading.Event()
+
+    def probe(c):
+        if c == "slow":
+            release.wait(5.0)  # a blackholed peer
+        return True
+
+    monitor = HeartbeatMonitor(
+        t, probe=probe, resync=lambda c: None, probe_deadline_s=1.0,
+    )
+    t0 = time.monotonic()
+    recovered = monitor.tick()
+    elapsed = time.monotonic() - t0
+    assert recovered == ["fast"], recovered
+    assert elapsed < 3.0, f"tick blocked on the hung probe ({elapsed:.1f}s)"
+    assert t.is_alive("fast") and not t.is_alive("slow")
+    release.set()
+    deadline = time.monotonic() + 5
+    while not t.is_alive("slow") and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # The overrunning probe still completed its revival in the background.
+    assert t.is_alive("slow")
+
+
+# ------------------------------------------------------------ proto layer
+def test_join_leave_proto_roundtrip():
+    req = proto.JoinRequest(address=b"localhost:5051")
+    assert proto.JoinRequest.decode(req.encode()) == req
+    rep = proto.JoinReply(admitted=1, seat=3, world=7, version=42,
+                          message=b"resynced")
+    assert proto.JoinReply.decode(rep.encode()) == rep
+    lreq = proto.LeaveRequest(address=b"localhost:5051")
+    assert proto.LeaveRequest.decode(lreq.encode()) == lreq
+    lrep = proto.LeaveReply(left=1, version=43)
+    assert proto.LeaveReply.decode(lrep.encode()) == lrep
+    # Proto3 defaults round-trip as empty bytes.
+    assert proto.JoinReply.decode(proto.JoinReply().encode()) == proto.JoinReply()
+
+
+# ------------------------------------------------- live-transport churn leg
+def _cfg(n, rounds=4, **fed_kw):
+    return chaos_soak._tiny_cfg(n, rounds, **fed_kw)
+
+
+def _fleet(cfg, n, seed0=0, ghost=False):
+    from fedtpu.transport.federation import serve_client
+    from fedtpu.transport.service import create_server
+
+    addrs, servers, agents = [], [], []
+    for i in range(n):
+        addr = f"localhost:{chaos_soak.free_port()}"
+        if ghost:
+            agent = chaos_soak.GhostableAgent(cfg, seed=seed0 + i)
+            server = create_server(addr, agent)
+            server.start()
+        else:
+            server, agent = serve_client(addr, cfg, seed=seed0 + i)
+        addrs.append(addr)
+        servers.append(server)
+        agents.append(agent)
+    return addrs, servers, agents
+
+
+def test_join_silent_leave_stale_rejoin_over_grpc():
+    """The tier-1 churn leg: a third client enters through the REAL Join
+    RPC mid-run and trains from the next round; a member leaves silently
+    (marked dead after retry exhaustion, nobody else affected); it returns
+    stale and is revived + resynced through the heartbeat path; a graceful
+    Leave frees its seat for the next joiner."""
+    from fedtpu.transport.federation import PrimaryServer
+    from fedtpu.transport.service import TrainerStub, create_channel
+
+    cfg = _cfg(2, rounds=8, retry=RetryPolicy(max_attempts=2, backoff_s=0.01),
+               ft_heartbeat_period_s=1e6)
+    addrs, servers, agents = _fleet(cfg, 3, ghost=True)
+    primary = None
+    try:
+        primary = PrimaryServer(cfg, addrs[:2])
+        gate_addr = f"localhost:{chaos_soak.free_port()}"
+        primary.start_gate(gate_addr)
+        stub = TrainerStub(create_channel(gate_addr))
+        rec = primary.round()
+        assert rec["participants"] == 2 and rec["world"] == 2
+        # --- dynamic join over the wire
+        reply = stub.Join(
+            proto.JoinRequest(address=addrs[2].encode()), timeout=10
+        )
+        assert reply.admitted == 1 and reply.seat == 2 and reply.world == 3
+        assert reply.message == b"resynced"
+        assert agents[2].trainer.synced  # the joiner holds the global NOW
+        rec = primary.round()
+        assert rec["participants"] == 3 and rec["world"] == 3
+        assert rec["membership_version"] == 1
+        assert agents[2].trainer.round_idx == 1
+        # --- silent leave: RPC failures exhaust retries -> dead, only it
+        agents[1].down = True
+        rec = primary.round()
+        assert rec["participants"] == 2
+        assert primary.registry.dead_clients() == [addrs[1]]
+        # --- stale rejoin: heartbeat probe + resync + revive
+        agents[1].down = False
+        assert primary.monitor.tick() == [addrs[1]]
+        rec = primary.round()
+        assert rec["participants"] == 3
+        # --- graceful leave frees the seat; the next joiner reuses it
+        reply = stub.Leave(
+            proto.LeaveRequest(address=addrs[1].encode()), timeout=10
+        )
+        assert reply.left == 1
+        assert primary.registry.clients == [addrs[0], addrs[2]]
+        rec = primary.round()
+        assert rec["participants"] == 2 and rec["world"] == 3
+        out = primary.admit_client(addrs[1])
+        assert out["seat"] == 1  # the freed seat, not a new one
+    finally:
+        if primary is not None:
+            primary.stop_gate()
+        for s in servers:
+            s.stop(0)
+
+
+def test_quorum_counts_current_members_not_startup_roster():
+    """round_quorum is a fraction of CURRENT members: dead-but-not-evicted
+    members hold the denominator up (abort), and evicting them is what
+    lets the survivors commit again."""
+    from fedtpu.transport.federation import PrimaryServer
+
+    cfg = _cfg(3, rounds=8, round_quorum=0.6,
+               retry=RetryPolicy(max_attempts=2, backoff_s=0.01),
+               ft_heartbeat_period_s=1e6)
+    addrs, servers, agents = _fleet(cfg, 3, ghost=True)
+    try:
+        primary = PrimaryServer(cfg, addrs)
+        rec = primary.round()
+        assert not rec.get("aborted")
+        # Two of three members leave silently: 1 reply < ceil(0.6*3)=2.
+        agents[1].down = True
+        agents[2].down = True
+        rec = primary.round()
+        assert rec.get("aborted") and rec["quorum_needed"] == 2
+        # Evicting the departed shrinks the electorate: ceil(0.6*1)=1 —
+        # the survivor commits.
+        primary.remove_client(addrs[1], reason="operator")
+        primary.remove_client(addrs[2], reason="operator")
+        rec = primary.round()
+        assert not rec.get("aborted") and rec["participants"] == 1
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+def test_membership_replicates_to_backup_and_survives_promotion():
+    """The roster (joins, evictions, alive flags, seats) rides the replica
+    payload: a promoted backup inherits the CURRENT membership, not the
+    startup list it was constructed with."""
+    from fedtpu.transport.federation import BackupServer, PrimaryServer
+
+    cfg = _cfg(2, rounds=8, ft_heartbeat_period_s=1e6)
+    addrs, servers, agents = _fleet(cfg, 3)
+    backup_srv = None
+    try:
+        backup_addr = f"localhost:{chaos_soak.free_port()}"
+        backup = BackupServer(cfg, addrs[:2], watchdog_timeout=3600.0)
+        backup_srv = backup.start(backup_addr)
+        primary = PrimaryServer(cfg, addrs[:2], backup_address=backup_addr)
+        primary.round()
+        primary.admit_client(addrs[2])          # join
+        primary.remove_client(addrs[0])         # leave -> seat 0 freed
+        primary.round()                          # replicates the new roster
+        backup._promote()
+        try:
+            acting = backup.acting
+            assert acting is not None
+            assert acting.registry.clients == [addrs[1], addrs[2]]
+            assert acting.registry.seat_of(addrs[2]) == 2
+            assert acting.registry.capacity() == 3
+            assert acting.registry.version >= 2
+            # The acting primary can drive the inherited fleet.
+            deadline = time.monotonic() + 30
+            while not acting.history and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert acting.history and acting.history[-1]["participants"] == 2
+        finally:
+            backup._stop_acting(wait=30.0)
+    finally:
+        if backup_srv is not None:
+            backup.watchdog.stop()
+            backup_srv.stop(0)
+        for s in servers:
+            s.stop(0)
+
+
+def test_statusz_membership_and_mem_blocks():
+    """/statusz carries the membership block (version/size/capacity/roster)
+    and the leak gauges; the prometheus registry exports
+    fedtpu_process_rss_bytes and fedtpu_buffer_bytes after a round."""
+    from fedtpu.obs import parse_prometheus_text, prometheus_text
+    from fedtpu.transport.federation import PrimaryServer
+
+    cfg = _cfg(2, rounds=4, delta_layout="flat")  # flat -> stream -> buffer
+    addrs, servers, agents = _fleet(cfg, 2)
+    try:
+        primary = PrimaryServer(cfg, addrs)
+        primary.round()
+        snap = primary.status_snapshot()
+        assert snap["membership"]["size"] == 2
+        assert snap["membership"]["capacity"] == 2
+        assert snap["membership"]["version"] == 0
+        assert snap["mem"]["rss_bytes"] > 0
+        assert snap["mem"]["buffer_bytes"] > 0  # streaming collect ran
+        parsed = parse_prometheus_text(
+            prometheus_text(primary.telemetry.registry)
+        )
+        assert sum(parsed["fedtpu_process_rss_bytes"].values()) > 0
+        assert sum(parsed["fedtpu_buffer_bytes"].values()) > 0
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+# ----------------------------------------------------- upgrade/churn drills
+def test_rolling_upgrade_zero_loss_bit_identical():
+    """Tier-1 rolling-upgrade acceptance at reduced scale: the scripted
+    primary -> backup -> primary handover loses zero rounds (lineage
+    0..N-1 exactly), retrains none (client round counts match the
+    control), and leaves the global model bit-identical to an unupgraded
+    control run — with a mid-run Join surviving both handovers."""
+    result = rolling_upgrade.run_upgrade_drill(
+        rounds=6, upgrade_round=2, clients=2, join_round=0,
+        acting_window=1, watchdog_s=1.0, verbose=False,
+    )
+    assert result["ok"]
+    assert result["lineage"]["exact_cover"]
+    assert result["bit_identical"]
+    assert result["generations"]["acting"] >= 1
+
+
+@pytest.mark.slow
+def test_churn_soak_1k_rounds():
+    """The full long-haul gate: 1000 rounds of continuous seeded churn +
+    one mid-soak rolling upgrade (see tools/chaos_soak.py --churn)."""
+    result = chaos_soak.run_churn_soak(rounds=1000, verbose=True)
+    assert result["ok"]
+    assert result["lineage"]["exact_cover"]
+    assert result["bit_identical_vs_control"]
+    assert result["memory"]["growth_pct"] < 8.0

@@ -421,3 +421,66 @@ def test_2k_population_64_cohort_fused_smoke():
     assert 0 < np.isfinite(fed.population.last_seen_loss).sum() <= 128
     snap = fed.status_snapshot()["sim"]
     assert snap["population"] == 2000 and snap["cohort_live"] == 64
+
+
+def test_population_membership_admit_evict_readmit():
+    """Dynamic membership in the sim layer: mid-run admits grow the host
+    tables (never the device seats), evicted clients are never sampled
+    however their availability trace rolls, and a readmitted client
+    returns with its bookkeeping (a stale rejoin, not a fresh client)."""
+    from fedtpu.sim.population import Population
+    from fedtpu.sim.samplers import UniformSampler
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 100, (6, 8)).astype(np.int32)
+    mask = np.ones((6, 8), bool)
+    pop = Population(idx, mask, seed=0)
+    pop.observe_loss(np.array([2]), np.array([1.5]))
+    # Evict: excluded from availability (and therefore from cohorts).
+    pop.evict(2)
+    assert not pop.available_at(0)[2]
+    sampler = UniformSampler(seed=0)
+    for r in range(5):
+        ids, alive = sampler.sample(pop, r, 5)
+        assert 2 not in set(ids[alive].tolist())
+    # Readmit: back in the pool, stale bookkeeping intact.
+    pop.readmit(2)
+    assert pop.available_at(5)[2]
+    assert pop.last_seen_loss[2] == np.float32(1.5)
+    # Admit a new client mid-run: tables grow, shorter shards are padded.
+    cid = pop.admit(np.arange(5, dtype=np.int32), np.ones(5, bool))
+    assert cid == 6 and pop.size == 7
+    assert pop.sizes[cid] == 5 and pop.mask[cid, 5:].sum() == 0
+    assert pop.available_at(6)[cid]
+    assert np.isnan(pop.last_seen_loss[cid])
+    assert pop.stats()["members"] == 7
+    # Oversized shards are rejected, mismatched rows too.
+    with pytest.raises(ValueError):
+        pop.admit(np.arange(9, dtype=np.int32), np.ones(9, bool))
+    with pytest.raises(ValueError):
+        pop.admit(np.arange(3, dtype=np.int32), np.ones(4, bool))
+
+
+def test_sim_federation_samples_admitted_client():
+    """A client admitted into a running SimFederation's population is
+    drawn into later cohorts through the UNCHANGED fixed-seat engine (the
+    values-only set_assignment swap — no recompile, no device growth)."""
+    fed = SimFederation(_cfg(6, 4), seed=0)
+    labels = np.asarray(fed.labels)
+    fed.step()
+    # Admit one new simulated client owning a fresh slice of the dataset.
+    new_idx = np.arange(min(16, len(labels)), dtype=np.int32)
+    cid = fed.population.admit(new_idx, np.ones(len(new_idx), bool))
+    assert cid == 6
+    seen = False
+    for _ in range(12):
+        fed.step()
+        if cid in set(fed._cohort_ids[fed.alive].tolist()):
+            seen = True
+            break
+    assert seen, "admitted client never sampled into a cohort"
+    # Device buffers stayed cohort-sized throughout.
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(fed.state.opt_state):
+        assert leaf.shape[0] == 4

@@ -1,7 +1,14 @@
 #!/usr/bin/env python
 """Chaos soak: N federated rounds under a seeded fault schedule, including
 a mid-round primary kill -> backup promotion -> primary recovery, driven
-against the LIVE gRPC transport.
+against the LIVE gRPC transport. ``--churn`` instead runs the long-haul
+ELASTIC-MEMBERSHIP soak (:func:`run_churn_soak`): 1k rounds of continuous
+seeded churn — dynamic joins over the Join RPC, silent leaves, stale
+rejoins, graceful Leave/rejoin cycles — plus one mid-soak rolling
+primary -> backup -> primary upgrade, verifying zero transient deaths, a
+strictly monotone lineage round counter, a bit-identical final model vs an
+unupgraded control run, and a FLAT memory profile from the ``/statusz``
+RSS gauge. Writes ``artifacts/CHURN_SOAK.json``.
 
 What it proves (the acceptance spine of the chaos/resilience PR;
 docs/FAULT_TOLERANCE.md):
@@ -138,9 +145,18 @@ def quorum_drill(seed: int = 7) -> dict:
         # below quorum -> abort.
         rec0 = primary.round()
         assert rec0.get("aborted"), f"expected round 0 abort, got {rec0}"
-        state_after_abort = jax.tree.map(np.asarray, primary.state_tree())
+
+        def round_state(server):
+            # The quorum contract covers the ROUND state (model, moments,
+            # lineage counter) — the membership leaf is roster state and
+            # legitimately changes as the abort marks clients dead.
+            tree = server.state_tree()
+            tree.pop("membership", None)
+            return jax.tree.map(np.asarray, tree)
+
+        state_after_abort = round_state(primary)
         fresh = PrimaryServer(cfg, [])  # same seed -> same init
-        state_initial = jax.tree.map(np.asarray, fresh.state_tree())
+        state_initial = round_state(fresh)
         mismatch = []
         jax.tree.map(
             lambda a, b: mismatch.append(True)
@@ -385,6 +401,533 @@ def run_soak(
             s.stop(0)
 
 
+# ---------------------------------------------------------------- churn soak
+class GhostableAgent:
+    """A ClientAgent whose reachability is a driver-controlled switch:
+    ``down=True`` makes every RPC abort UNAVAILABLE — a silent departure —
+    and ``down=False`` brings the SAME stateful agent back (a stale
+    rejoin: its weights/optimizer/round counter are wherever it left
+    them). Built lazily so jax imports stay inside the soak."""
+
+    def __new__(cls, cfg, seed):
+        import grpc
+
+        from fedtpu.transport.federation import ClientAgent
+
+        class _Ghost(ClientAgent):
+            def __init__(self, cfg, seed):
+                super().__init__(cfg, seed=seed)
+                self.down = False
+
+            def _gate(self, context):
+                if self.down:
+                    context.abort(grpc.StatusCode.UNAVAILABLE,
+                                  "ghost: silently departed")
+
+            def StartTrain(self, request, context):
+                self._gate(context)
+                return super().StartTrain(request, context)
+
+            def SendModel(self, request, context):
+                self._gate(context)
+                return super().SendModel(request, context)
+
+            def HeartBeat(self, request, context):
+                self._gate(context)
+                return super().HeartBeat(request, context)
+
+        return _Ghost(cfg, seed)
+
+
+class ChurnDriver:
+    """Deterministic churn scheduler, driven from the round loop's
+    ``on_round`` callback (so actions land at exact committed lineage
+    rounds — identical in the upgrade run and its control run).
+
+    Actions per committed round r (modular schedule seeded once):
+
+    - **new join** at each round in ``join_rounds``: start a fresh serving
+      agent and admit it through the REAL Join RPC against the current
+      membership gate;
+    - **silent leave** (r % 29 == 13, outside the final grace window):
+      flip a live member's ghost switch — next round its StartTrain
+      exhausts retries and the coordinator marks it dead (the ONLY
+      expected deaths of the soak);
+    - **stale rejoin** (r % 29 == 25): flip the switches back and tick the
+      heartbeat monitor — the members revive through the probe + resync
+      path with their stale local state;
+    - **graceful leave** (r % 47 == 11): a previously-joined member sends
+      Leave — evicted, seat freed;
+    - **graceful rejoin** (r % 47 == 31): the departed members Join again
+      (taking the freed seats back).
+
+    The driver's OWN ledger (up/member flags) decides victim validity, so
+    the schedule replays identically however coordinator bookkeeping lags.
+    """
+
+    def __init__(self, cfg, rounds, join_seeds, join_rounds, rss_every=10):
+        self.cfg = cfg
+        self.rounds = rounds
+        self.join_seeds = list(join_seeds)
+        self.join_rounds = list(join_rounds)
+        self.rss_every = rss_every
+        self.coord = None        # current coordinator (set by orchestrator)
+        self.gate_stub = None    # current Join/Leave target
+        self.obs_url = None      # /statusz endpoint for the RSS series
+        self.servers = []        # grpc servers we own (for teardown)
+        self.agents = {}         # addr -> agent (ghostables)
+        self.up = {}             # addr -> driver's view of reachability
+        self.member = {}         # addr -> driver's view of membership
+        self.joined = []         # join-pool addrs in admission order
+        self.order = []          # every agent ever created, creation order
+        self.records = []        # committed round records, arrival order
+        # Rounds where gate actions + revivals are suppressed (the drain
+        # window before a promotion: see run_churn_soak's docstring).
+        self.blackout = set()
+        self.expected_deaths = 0
+        self.scheduled = {"join": 0, "silent_leave": 0, "stale_rejoin": 0,
+                          "leave": 0, "rejoin": 0}
+        self.rss_series = []
+        self.buffer_series = []
+
+    def add_initial(self, addrs, agents):
+        for addr, agent in zip(addrs, agents):
+            self.agents[addr] = agent
+            self.order.append(addr)
+            self.up[addr] = True
+            self.member[addr] = True
+
+    def _join(self, addr) -> None:
+        from fedtpu.transport import proto
+
+        reply = self.gate_stub.Join(
+            proto.JoinRequest(address=addr.encode()), timeout=10,
+        )
+        assert reply.admitted, f"gate refused join of {addr}"
+        self.member[addr] = True
+        self.scheduled["join" if addr not in self.joined else "rejoin"] += 1
+        if addr not in self.joined:
+            self.joined.append(addr)
+
+    def _leave(self, addr) -> None:
+        from fedtpu.transport import proto
+
+        reply = self.gate_stub.Leave(
+            proto.LeaveRequest(address=addr.encode()), timeout=10,
+        )
+        assert reply.left, f"gate refused leave of {addr}"
+        self.member[addr] = False
+        self.scheduled["leave"] += 1
+
+    def on_round(self, r: int, rec: dict) -> None:
+        if rec.get("aborted"):
+            return
+        r = int(rec.get("round", r))
+        self.records.append(rec)
+        if self.obs_url and (r % self.rss_every == 0 or r == self.rounds - 1):
+            try:
+                with urllib.request.urlopen(
+                    f"{self.obs_url}/statusz", timeout=5
+                ) as resp:
+                    snap = json.loads(resp.read().decode())
+                mem = snap.get("mem", {})
+                self.rss_series.append([r, int(mem.get("rss_bytes", 0))])
+                self.buffer_series.append(
+                    [r, int(mem.get("buffer_bytes", 0))]
+                )
+            except Exception:
+                pass
+        if r in self.blackout:
+            return  # drain window: no roster changes the replica would miss
+        # New joiners enter through the gate at their scheduled rounds.
+        if r in self.join_rounds:
+            i = self.join_rounds.index(r)
+            addr = f"localhost:{free_port()}"
+            agent = GhostableAgent(self.cfg, seed=self.join_seeds[i])
+            from fedtpu.transport.service import create_server
+
+            server = create_server(addr, agent)
+            server.start()
+            self.servers.append(server)
+            self.agents[addr] = agent
+            self.order.append(addr)
+            self.up[addr] = True
+            self._join(addr)
+        grace = r < self.rounds - 5  # deaths must land before the end
+        # Victim/revival order is CREATION order, never address order:
+        # ports differ between a run and its control, and an address sort
+        # would churn different clients in each (breaking bit-parity).
+        pool = [a for a in self.order if self.member[a]]
+        if grace and r % 29 == 13 and pool:
+            victim = pool[(r // 29) % len(pool)]
+            if self.up[victim]:
+                self.agents[victim].down = True
+                self.up[victim] = False
+                self.expected_deaths += 1
+                self.scheduled["silent_leave"] += 1
+        if r % 29 == 25:
+            stale = [
+                a for a in self.order if self.member[a] and not self.up[a]
+            ]
+            for addr in stale:
+                self.agents[addr].down = False
+                self.up[addr] = True
+            if stale:
+                self.scheduled["stale_rejoin"] += len(stale)
+                self.coord.monitor.tick()
+        if grace and r % 47 == 11 and self.joined:
+            leaver = self.joined[(r // 47) % len(self.joined)]
+            if self.member[leaver] and self.up[leaver]:
+                self._leave(leaver)
+        if r % 47 == 31:
+            for addr in [a for a in self.joined if not self.member[a]]:
+                if self.up[addr]:
+                    self._join(addr)
+
+    def teardown(self):
+        for s in self.servers:
+            s.stop(0)
+
+
+def _flatness(series, rounds):
+    """RSS growth between the settled first and final windows, in percent
+    (warmup — jit caches for the joiner fleet — excluded)."""
+    settled = [v for r, v in series if r >= 0.3 * rounds]
+    if len(settled) < 8:
+        return {"samples": len(settled), "growth_pct": 0.0}
+    k = max(1, len(settled) // 4)
+    first = sum(settled[:k]) / k
+    last = sum(settled[-k:]) / k
+    return {
+        "samples": len(series),
+        "settled_samples": len(settled),
+        "first_window_bytes": int(first),
+        "last_window_bytes": int(last),
+        "growth_pct": round((last / max(first, 1.0) - 1.0) * 100.0, 3),
+    }
+
+
+def run_churn_soak(
+    rounds: int = 1000,
+    initial_clients: int = 4,
+    joiners: int = 3,
+    upgrade_round=None,
+    quorum: float = 0.25,
+    watchdog_s: float = 2.0,
+    error_p: float = 0.12,
+    retries: int = 6,
+    acting_window: int = 20,
+    seed: int = 7,
+    rss_every: int = 10,
+    rss_growth_limit_pct: float = 8.0,
+    verbose: bool = True,
+) -> dict:
+    """The long-haul elastic-membership soak (module docstring, and the
+    acceptance gate of the elastic-membership PR). Returns the result dict;
+    raises AssertionError on any violated invariant.
+
+    Determinism: every churn action keys on the committed LINEAGE round, so
+    the unupgraded control run replays the identical membership history;
+    the chaos errors are injected client-side pre-call and consec-capped
+    under the retry budget, so they perturb timing and counters but never
+    the training trajectory. The only intentional non-determinism is WHERE
+    the two handover boundaries fall — which, by the zero-loss design,
+    must not matter; the bit-identical gate is exactly that claim. Gate
+    actions and revivals are blacked out for the 3 rounds before the
+    drain (the last pre-promotion replica is pushed a round earlier, so a
+    roster change there would be invisible to the acting primary but not
+    to the control run); the acting -> gen2 handover needs no blackout
+    because FetchModel serializes the CURRENT state at fetch time.
+    """
+    from fedtpu.config import RetryPolicy
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import ObsServer, parse_prometheus_text, prometheus_text
+    from fedtpu.transport.federation import BackupServer, PrimaryServer
+    from fedtpu.transport.service import TrainerStub, create_channel
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import rolling_upgrade as ru
+
+    if upgrade_round is None:
+        upgrade_round = rounds // 2
+    assert 0 < upgrade_round < rounds
+    t_start = time.monotonic()
+
+    def note(msg):
+        if verbose:
+            print(f"[churn] {msg}", flush=True)
+
+    base_cfg = ru.tiny_cfg(
+        initial_clients, rounds,
+        round_quorum=quorum,
+        # flat layout -> streaming collect: the fedtpu_buffer_bytes gauge
+        # then watches a real per-round allocation.
+        delta_layout="flat",
+        retry=RetryPolicy(max_attempts=retries, backoff_s=0.01),
+    )
+    join_rounds = []
+    blackout = set(range(upgrade_round - 3, upgrade_round))
+    for i in range(joiners):
+        r = min(max(2, round(rounds * 0.06 * (i + 1))), rounds - 10)
+        while r in blackout:
+            r += 4
+        join_rounds.append(r)
+    join_seeds = [initial_clients + i for i in range(joiners)]
+
+    def build_driver():
+        from fedtpu.transport.service import create_server
+
+        addrs, agents, servers = [], [], []
+        for i in range(initial_clients):
+            addr = f"localhost:{free_port()}"
+            agent = GhostableAgent(base_cfg, seed=i)
+            server = create_server(addr, agent)
+            server.start()
+            servers.append(server)
+            addrs.append(addr)
+            agents.append(agent)
+        driver = ChurnDriver(
+            base_cfg, rounds, join_seeds, join_rounds, rss_every=rss_every,
+        )
+        driver.blackout = blackout
+        driver.servers.extend(servers)
+        driver.add_initial(addrs, agents)
+        return driver, addrs
+
+    # The error schedule is PRE-CALL and consec-capped under the retry
+    # budget: injected attempts never reach an agent and never exhaust, so
+    # the chaos is bit-transparent to the training trajectory (the control
+    # run need not replay the same port-keyed draws).
+    chaos_spec = f"error@StartTrain:p={error_p},consec=2,seed={seed}"
+    assert retries > 3, "retry budget must exceed the consec cap"
+
+    def counters_sum(primaries, name):
+        """Sum a counter (all label sets) across coordinator registries."""
+        total = 0.0
+        for p in primaries:
+            if p is None:
+                continue
+            parsed = parse_prometheus_text(
+                prometheus_text(p.telemetry.registry)
+            )
+            total += sum(parsed.get(name, {}).values())
+        return total
+
+    result: dict = {"config": {
+        "rounds": rounds, "initial_clients": initial_clients,
+        "joiners": joiners, "upgrade_round": upgrade_round,
+        "quorum": quorum, "watchdog_s": watchdog_s, "error_p": error_p,
+        "retries": retries, "seed": seed, "chaos_spec": chaos_spec,
+        "join_rounds": join_rounds,
+    }}
+
+    # ------------------------------------------------------ upgraded run
+    note(f"upgrade run: {rounds} rounds, {initial_clients}+{joiners} "
+         f"clients, rolling upgrade at round {upgrade_round}")
+    driver, addrs = build_driver()
+    obs = ObsServer(port=0, status_fn=lambda: driver.coord.status_snapshot())
+    obs.start()
+    driver.obs_url = obs.url
+    backup = backup_srv = None
+    gen1 = gen2 = None
+    try:
+        backup_addr = f"localhost:{free_port()}"
+        backup = BackupServer(
+            base_cfg, addrs, watchdog_timeout=watchdog_s,
+            on_acting_round=lambda r, rec: (
+                setattr(driver, "coord", backup.acting),
+                driver.on_round(r, rec),
+            )[-1],
+        )
+        backup_srv = backup.start(backup_addr)
+        gate1_addr = f"localhost:{free_port()}"
+        gen1 = PrimaryServer(
+            base_cfg, addrs, backup_address=backup_addr,
+            chaos=parse_spec(chaos_spec),
+        )
+        gen1.start_gate(gate1_addr)
+        driver.coord = gen1
+        driver.gate_stub = TrainerStub(create_channel(gate1_addr))
+        note(f"phase 1: gen 1 drives rounds 0..{upgrade_round - 1}, "
+             "then drains for the upgrade")
+        gen1.run(num_rounds=upgrade_round, on_round=driver.on_round)
+        gen1.stop_gate()
+        # While the "new binary rolls out", the backup bridges: joins and
+        # leaves retarget the backup's stable address (it delegates to its
+        # acting primary once promoted).
+        driver.gate_stub = TrainerStub(create_channel(backup_addr))
+        note("phase 2: watchdog promotes the backup; acting primary "
+             f"bridges ~{acting_window} rounds")
+        target = min(rounds, upgrade_round + acting_window)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if driver.records and int(
+                driver.records[-1]["round"]
+            ) >= target - 1:
+                break
+            time.sleep(0.2)
+        assert backup.acting is not None, "backup never promoted"
+        acting = backup.acting
+        note("phase 3: upgraded gen 2 announces itself, pulls state, "
+             "finishes the soak")
+        gen2 = PrimaryServer(
+            base_cfg, addrs, backup_address=backup_addr,
+            chaos=parse_spec(chaos_spec),
+        )
+        gen2.pinger.tick()  # demote + drain + FetchModel install
+        gate2_addr = f"localhost:{free_port()}"
+        gen2.start_gate(gate2_addr)
+        driver.coord = gen2
+        driver.gate_stub = TrainerStub(create_channel(gate2_addr))
+        acting_committed = gen2._round_counter - upgrade_round
+        assert acting_committed >= 1, "acting primary committed no rounds"
+        remaining = rounds - gen2._round_counter
+        gen2.run(num_rounds=remaining, on_round=driver.on_round)
+        gen2.stop_gate()
+
+        primaries = [gen1, acting, gen2]
+        lineage = [int(r["round"]) for r in driver.records]
+        u_model = ru.model_fingerprint(gen2)
+        u_counts = [
+            driver.agents[a].trainer.round_idx for a in driver.order
+        ]
+        result["generations"] = {
+            "gen1": upgrade_round,
+            "acting": int(acting_committed),
+            "gen2": int(remaining),
+        }
+        result["lineage"] = {
+            "committed": len(lineage),
+            "strictly_monotone": all(
+                b == a + 1 for a, b in zip(lineage, lineage[1:])
+            ),
+            "exact_cover": lineage == list(range(rounds)),
+        }
+        result["scheduled"] = dict(driver.scheduled)
+        result["expected_silent_deaths"] = driver.expected_deaths
+        result["observed"] = {
+            "client_deaths": counters_sum(
+                primaries, "fedtpu_ft_client_deaths_total"),
+            "recoveries": counters_sum(
+                primaries, "fedtpu_ft_client_recoveries_total"),
+            "rpc_retries": counters_sum(
+                primaries, "fedtpu_rpc_retries_total"),
+            "chaos_injected": counters_sum(
+                primaries, "fedtpu_chaos_injected_total"),
+            "membership_joins": counters_sum(
+                primaries, "fedtpu_membership_joins_total"),
+            "membership_evictions": counters_sum(
+                primaries, "fedtpu_membership_evictions_total"),
+            "round_aborts": counters_sum(
+                primaries, "fedtpu_round_aborts_total"),
+        }
+        result["final_roster"] = gen2.registry.status()
+        result["memory"] = _flatness(driver.rss_series, rounds)
+        result["memory"]["rss_series_sampled"] = driver.rss_series[::5]
+        result["memory"]["buffer_bytes_last"] = (
+            driver.buffer_series[-1][1] if driver.buffer_series else 0
+        )
+    finally:
+        if backup is not None:
+            backup.watchdog.stop()
+            backup._stop_acting(wait=30.0)
+        if backup_srv is not None:
+            backup_srv.stop(0)
+        if gen1 is not None:
+            gen1.stop_gate()
+        if gen2 is not None:
+            gen2.stop_gate()
+        obs.stop()
+        driver.teardown()
+
+    # ------------------------------------------------------- control run
+    note("control run: identical churn schedule, no upgrade")
+    driver2, addrs2 = build_driver()
+    control = None
+    try:
+        control = PrimaryServer(
+            base_cfg, addrs2, chaos=parse_spec(chaos_spec),
+        )
+        gate_c = f"localhost:{free_port()}"
+        control.start_gate(gate_c)
+        driver2.coord = control
+        driver2.gate_stub = TrainerStub(create_channel(gate_c))
+        control.run(num_rounds=rounds, on_round=driver2.on_round)
+        control.stop_gate()
+        c_model = ru.model_fingerprint(control)
+        c_counts = [
+            driver2.agents[a].trainer.round_idx for a in driver2.order
+        ]
+        c_deaths = counters_sum(
+            [control], "fedtpu_ft_client_deaths_total")
+    finally:
+        if control is not None:
+            control.stop_gate()
+        driver2.teardown()
+
+    result["bit_identical_vs_control"] = ru.bit_identical(c_model, u_model)
+    result["client_round_counts"] = {
+        "control": c_counts, "upgraded": u_counts,
+    }
+    result["wall_s"] = round(time.monotonic() - t_start, 2)
+
+    # ------------------------------------------------------- the gates
+    obs_d = result["observed"]
+    assert result["lineage"]["exact_cover"], (
+        "lineage round counter not exactly 0..N-1 "
+        f"(committed {result['lineage']['committed']})"
+    )
+    assert obs_d["client_deaths"] == driver.expected_deaths, (
+        f"{obs_d['client_deaths']} deaths observed, "
+        f"{driver.expected_deaths} silent leaves scheduled — transient "
+        "faults killed clients"
+    )
+    assert c_deaths == driver2.expected_deaths, (
+        f"control run: {c_deaths} deaths vs "
+        f"{driver2.expected_deaths} scheduled"
+    )
+    assert obs_d["rpc_retries"] > 0 and obs_d["chaos_injected"] > 0, (
+        "the chaos schedule never exercised the retry path"
+    )
+    assert obs_d["membership_joins"] == (
+        driver.scheduled["join"] + driver.scheduled["rejoin"]
+    ), (result["scheduled"], obs_d)
+    assert obs_d["membership_evictions"] == driver.scheduled["leave"], (
+        result["scheduled"], obs_d,
+    )
+    assert obs_d["round_aborts"] == 0, (
+        f"{obs_d['round_aborts']} unexpected sub-quorum aborts"
+    )
+    assert driver.scheduled["join"] == joiners
+    assert min(driver.scheduled["silent_leave"],
+               driver.scheduled["stale_rejoin"],
+               driver.scheduled["leave"],
+               driver.scheduled["rejoin"]) > 0, (
+        "a churn mode never fired: " + json.dumps(driver.scheduled)
+    )
+    assert u_counts == c_counts, (
+        "per-client round counts diverged (a round was lost or "
+        f"retrained): control={c_counts} upgraded={u_counts}"
+    )
+    assert result["bit_identical_vs_control"], (
+        "post-upgrade global model differs from the unupgraded control"
+    )
+    mem = result["memory"]
+    if rounds >= 300:
+        # The leak gate needs a LONG soak: below ~300 rounds the settled
+        # window is all jit-cache warmup and the slope means nothing.
+        assert mem.get("settled_samples", 0) >= 8, mem
+        assert mem["growth_pct"] < rss_growth_limit_pct, (
+            f"RSS grew {mem['growth_pct']}% across the soak "
+            f"(limit {rss_growth_limit_pct}%) — leak"
+        )
+        mem["gate"] = f"growth < {rss_growth_limit_pct}% (enforced)"
+    else:
+        mem["gate"] = "skipped (short run; enforced from 300 rounds)"
+    result["ok"] = True
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", default=20, type=int)
@@ -397,9 +940,44 @@ def main(argv=None) -> int:
                     help="retry budget; must exceed the worst interleaved "
                     "chaos run (2*3+1 attempts under the default spec)")
     ap.add_argument("--workdir", default="/tmp/fedtpu_chaos_soak")
+    ap.add_argument(
+        "--churn", action="store_true",
+        help="run the long-haul elastic-membership churn soak instead "
+        "(continuous join/leave/rejoin + one mid-soak rolling upgrade; "
+        "writes artifacts/CHURN_SOAK.json)",
+    )
+    ap.add_argument("--churn-rounds", default=1000, type=int)
+    ap.add_argument("--initial-clients", default=4, type=int)
+    ap.add_argument("--joiners", default=3, type=int)
+    ap.add_argument("--upgrade-round", default=None, type=int,
+                    help="lineage round of the mid-soak rolling upgrade "
+                    "(default: --churn-rounds / 2)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.churn:
+        try:
+            result = run_churn_soak(
+                rounds=args.churn_rounds,
+                initial_clients=args.initial_clients,
+                joiners=args.joiners,
+                upgrade_round=args.upgrade_round,
+                seed=args.seed,
+                error_p=args.error_p,
+                retries=max(args.retries, 4),
+            )
+        except AssertionError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}))
+            return 1
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "CHURN_SOAK.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "memory"} | {"memory": {
+                              k: v for k, v in result["memory"].items()
+                              if k != "rss_series_sampled"}}))
+        return 0
     try:
         result = run_soak(
             rounds=args.rounds, clients=args.clients,

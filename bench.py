@@ -1053,6 +1053,137 @@ def _chaos_overhead_microbench():
     return result
 
 
+def _screening_overhead_microbench():
+    """``screening_overhead``: what the fused Byzantine screening stage
+    (:func:`fedtpu.ops.flat.screen_rows` — per-row L2 norm, cosine to the
+    median direction, median/MAD z-score, all one jitted program) costs per
+    round. The acceptance gate of the Byzantine PR: screening must ride
+    the default fast path at <= 1% of round wall time — it runs on the
+    SAME device-resident ``[clients, P]`` buffer the stream finalize reads,
+    so the only new work is the one fused stats pass measured here.
+
+    Same two-measurement methodology as ``--chaos-overhead-microbench``:
+
+    - **Attributable cost** (the headline ``value``): the fused screening
+      pass over a ``[clients, P]`` buffer of the headline model's real
+      padded row width, timed directly (device-synced per call) and scaled
+      by the bare round wall. Gate: <= 1% (``gate_pct``/``passes_gate``).
+    - **A/B walls (audit)**: the same engine config compiled with
+      screening off vs armed (thresholds set loose so no row is ever
+      rejected — the verdict math runs, the trajectory is unchanged),
+      mode order rotated per rep, medians next to the bare trials' spread
+      (``noise_floor_pct``).
+
+    Run via ``python bench.py --screening-overhead-microbench``; prints one
+    JSON line and writes ``artifacts/SCREENING_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig, ScreenConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.ops import flat as flat_ops
+
+    model_name = os.environ.get("FEDTPU_SC_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_SC_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_SC_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_SC_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_SC_BATCH", "8"))
+
+    def make_cfg(screen):
+        return RoundConfig(
+            model=model_name,
+            num_classes=10,
+            data=DataConfig(
+                dataset="cifar10", batch_size=batch, partition="iid",
+                num_examples=clients * batch * 4,
+            ),
+            fed=FedConfig(
+                num_clients=clients, telemetry="off", screen=screen,
+            ),
+            steps_per_round=1,
+        )
+
+    # Armed-but-lenient: every check runs, nothing is ever rejected, so
+    # the A/B trajectories stay comparable.
+    armed = ScreenConfig(norm_max=1e30, zmax=1e6, cos_min=-1.0)
+    bare_fed = Federation(make_cfg(ScreenConfig()), seed=0)
+    screen_fed = Federation(make_cfg(armed), seed=0)
+
+    def run_block(fed):
+        for _ in range(rounds):
+            m = fed.step()
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+
+    run_block(bare_fed)  # compile + warmup
+    run_block(screen_fed)
+    modes = ("bare", "screen")
+    feds = {"bare": bare_fed, "screen": screen_fed}
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        for mode in modes if rep % 2 == 0 else modes[::-1]:
+            t0 = time.perf_counter()
+            run_block(feds[mode])
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = (med["screen"] - med["bare"]) / med["bare"] * 100.0
+    noise_floor_pct = (
+        (max(trials["bare"]) - min(trials["bare"])) / med["bare"] * 100.0
+    )
+
+    # Attributable cost: the exact fused screening pass over the model's
+    # real padded row width, timed directly with a device sync per call.
+    layout = flat_ops.make_layout(bare_fed.state.params)
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(
+        rng.normal(size=(clients, layout.padded)).astype(np.float32)
+    )
+    live = jnp.ones((clients,), jnp.float32)
+    screen_fn = jax.jit(
+        lambda r, a: flat_ops.screen_rows(
+            r, a, armed.norm_max, armed.zmax, armed.cos_min
+        )
+    )
+    jax.block_until_ready(screen_fn(rows, live))  # compile
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        keep, _ = screen_fn(rows, live)
+    jax.block_until_ready(keep)
+    screen_us = (time.perf_counter() - t0) / n * 1e6
+    attributable_pct = screen_us / (med["bare"] * 1e6) * 100.0
+
+    result = {
+        "metric": "screening_overhead",
+        "unit": "% of round wall time attributable to the fused "
+                "screening pass",
+        "value": round(attributable_pct, 6),
+        "gate_pct": 1.0,
+        "passes_gate": bool(attributable_pct <= 1.0),
+        "per_round_screen_us": round(screen_us, 3),
+        "padded_row": int(layout.padded),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "SCREENING_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 ARTIFACTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
 
 
@@ -1335,6 +1466,9 @@ def main():
         return
     if "--chaos-overhead-microbench" in sys.argv:
         print(json.dumps(_chaos_overhead_microbench()))
+        return
+    if "--screening-overhead-microbench" in sys.argv:
+        print(json.dumps(_screening_overhead_microbench()))
         return
     if "--cohort-scale" in sys.argv:
         print(json.dumps(_cohort_scale()))

@@ -19,6 +19,7 @@ from fedtpu.config import (
     OptimizerConfig,
     RetryPolicy,
     RoundConfig,
+    ScreenConfig,
     SimConfig,
 )
 from fedtpu.data import dataset_info
@@ -217,12 +218,78 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         "Chrome trace JSON (--trace-out) and bridged to "
         "jax.profiler.TraceAnnotation under --profile-dir",
     )
+    add_screening_flags(p)
     p.add_argument(
         "--debug-per-batch",
         action="store_true",
         help="print per-batch loss/acc from inside the jitted local epoch "
         "(the reference's mid-epoch console lines, src/utils.py:51-92). "
         "Host callback per batch — debugging only, ruins throughput",
+    )
+
+
+def add_screening_flags(p: argparse.ArgumentParser) -> None:
+    """Fused update screening + reputation/quarantine (ScreenConfig;
+    docs/FAULT_TOLERANCE.md). All checks default OFF; arming any one turns
+    screening on. Composes with --server-pipeline stream and every
+    aggregator (unlike median/krum, which are barrier-only)."""
+    p.add_argument(
+        "--screen-norm",
+        default=0.0,
+        type=float,
+        metavar="L2",
+        help="reject client updates whose L2 norm exceeds this absolute "
+        "bound (0 = off) — the blunt defense against boosted updates",
+    )
+    p.add_argument(
+        "--screen-z",
+        default=0.0,
+        type=float,
+        metavar="Z",
+        help="reject updates whose norm's modified z-score (median/MAD of "
+        "the live cohort — robust to the attackers inflating the spread) "
+        "exceeds this bound (0 = off; ~3.5 is the textbook outlier cut)",
+    )
+    p.add_argument(
+        "--screen-cos",
+        default=-1.0,
+        type=float,
+        metavar="COS",
+        help="reject updates whose cosine against the live cohort's "
+        "coordinate-wise median direction falls below this (-1 = off; "
+        "0 rejects sign-flipped/contrarian updates)",
+    )
+    p.add_argument(
+        "--quarantine-at",
+        default=ScreenConfig.quarantine_at,
+        type=float,
+        metavar="S",
+        help="suspicion EWMA threshold (of per-round screening verdicts) "
+        "at which a client is quarantined: still served, updates ignored, "
+        "release when suspicion decays below the release threshold",
+    )
+    p.add_argument(
+        "--quarantine-evict-after",
+        default=ScreenConfig.evict_after,
+        type=int,
+        metavar="ROUNDS",
+        help="consecutive quarantined rounds before the client is evicted "
+        "through the live membership machinery (0 = never auto-evict)",
+    )
+
+
+def screen_config(args) -> ScreenConfig:
+    """ScreenConfig from the screening flags (defaults = screening off)."""
+    return ScreenConfig(
+        norm_max=getattr(args, "screen_norm", 0.0),
+        zmax=getattr(args, "screen_z", 0.0),
+        cos_min=getattr(args, "screen_cos", -1.0),
+        quarantine_at=getattr(
+            args, "quarantine_at", ScreenConfig.quarantine_at
+        ),
+        evict_after=getattr(
+            args, "quarantine_evict_after", ScreenConfig.evict_after
+        ),
     )
 
 
@@ -296,6 +363,26 @@ def add_sim_flags(p: argparse.ArgumentParser) -> None:
         help="optimistic sampling prior for never-sampled clients under "
         "--cohort-sampler loss; negative (default) = the max observed loss",
     )
+    p.add_argument(
+        "--malicious-fraction",
+        default=0.0,
+        type=float,
+        metavar="FRACTION",
+        help="seed this fraction of the simulated population (or of "
+        "--num-clients on the resident engine) as Byzantine clients "
+        "executing --attack (fedtpu.sim.adversary); attacker identity and "
+        "every per-round decision replay bit-identically from the seed",
+    )
+    p.add_argument(
+        "--attack",
+        default="sign_flip",
+        metavar="SPEC",
+        help="what seeded attackers do: kind[:key=val,...] with kinds "
+        "sign_flip | scale:factor=F | noise:std=S | label_flip:offset=K "
+        "and shared options p= (fire probability), rounds=lo-hi, "
+        "collude=1 (one shared draw/noise vector for the whole malicious "
+        "set), seed=",
+    )
 
 
 def sim_config(args) -> SimConfig:
@@ -309,6 +396,8 @@ def sim_config(args) -> SimConfig:
         availability=getattr(args, "availability", 1.0),
         churn=getattr(args, "churn", 0.0),
         seed=getattr(args, "sim_seed", 0),
+        malicious_fraction=getattr(args, "malicious_fraction", 0.0),
+        attack=getattr(args, "attack", "sign_flip"),
     )
 
 
@@ -637,6 +726,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
             telemetry=getattr(args, "telemetry", "basic"),
             sim=sim_config(args),
+            screen=screen_config(args),
             **robustness_config(args),
         ),
         steps_per_round=steps_per_round,

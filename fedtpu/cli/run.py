@@ -187,16 +187,18 @@ def main(argv=None) -> int:
                 accs = np.asarray(stacked.accuracy)
                 actives = np.asarray(stacked.num_active)
                 worsts = np.asarray(stacked.per_client_loss).max(axis=1)
+                screens = np.asarray(stacked.screened).sum(axis=1)
                 per_round = [
                     (float(losses[i]), float(accs[i]), float(actives[i]),
-                     float(worsts[i]))
+                     float(worsts[i]), int(screens[i]))
                     for i in range(block)
                 ]
             else:
                 m = fed.step()
                 per_round = [
                     (float(m.loss), float(m.accuracy), float(m.num_active),
-                     float(np.asarray(m.per_client_loss).max()))
+                     float(np.asarray(m.per_client_loss).max()),
+                     int(np.asarray(m.screened).sum()))
                 ]
             # Eval/checkpoint cadences in fused mode: mid-block model states
             # never exist on the host, so a cadence point inside a block is
@@ -206,7 +208,11 @@ def main(argv=None) -> int:
             crossed_eval = args.eval_every and (
                 (r + block) // args.eval_every > r // args.eval_every
             )
-            for i, (loss, acc, active, worst) in enumerate(per_round):
+            from fedtpu.config import screening_enabled
+
+            for i, (loss, acc, active, worst, screened) in enumerate(
+                per_round
+            ):
                 ri = r + i
                 rec = {
                     "loss": loss,
@@ -218,6 +224,15 @@ def main(argv=None) -> int:
                     # curves are not comparable to real-data results.
                     "data_source": fed.data_source,
                 }
+                if screening_enabled(cfg.fed.screen):
+                    rec["screened"] = screened
+                    if screened:
+                        fed.telemetry.counter(
+                            "fedtpu_screening_rejected_total",
+                            "client rows rejected by the fused screening "
+                            "stage, by surface",
+                            labels={"surface": "engine"},
+                        ).inc(screened)
                 if crossed_eval and i == len(per_round) - 1:
                     rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
                 logger.log(ri, **rec)

@@ -82,6 +82,83 @@ def validate_retry_policy(rp: RetryPolicy) -> RetryPolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class ScreenConfig:
+    """Fused update screening + client reputation (docs/FAULT_TOLERANCE.md).
+
+    Screening is the defense for the DEFAULT fast path: median/trimmed_mean/
+    krum protect the aggregate but are barrier-only and rewrite its math;
+    screening instead REJECTS suspicious client rows before any combine, as
+    one fused stats pass over the flat ``[clients, P]`` delta buffer
+    (:func:`fedtpu.ops.flat.screen_rows`) — so it composes with
+    ``server_pipeline='stream'``, with the plain mean, and with the robust
+    aggregators (screened rows simply drop out of the weighted/robust
+    combine through the existing exclusion mask, bit-cleanly).
+
+    Three per-row statistics, each gated by its own threshold (0 / -1 =
+    that check off; screening as a whole is off when all three are off):
+
+    - ``norm_max``: absolute L2 bound on the update row — the blunt
+      norm-bound defense against boosted/scaled updates.
+    - ``zmax``: modified z-score bound on the row norms, computed against
+      the live cohort's median/MAD (robust to the attackers inflating the
+      spread, unlike a mean/std z-score); rejects norm outliers without an
+      absolute calibration.
+    - ``cos_min``: minimum cosine of the row against the live cohort's
+      coordinate-wise median direction; rejects sign-flipped/contrarian
+      updates whose norms look ordinary.
+
+    Reputation closes the loop from per-round verdicts to membership
+    action: every screening verdict feeds a per-client suspicion EWMA
+    (``s' = (1-ewma)*s + ewma*flagged``) held on the
+    :class:`~fedtpu.ft.membership.MembershipTable` and replicated to the
+    backup. ``s >= quarantine_at`` escalates flagged -> QUARANTINED (the
+    client still receives broadcasts and StartTrain — it can redeem itself
+    — but its updates are ignored unconditionally); dropping back below
+    ``release_at`` releases it; ``evict_after`` consecutive quarantined
+    rounds escalates to eviction through the live membership machinery
+    (``remove_client(reason='quarantine')``). ``evict_after=0`` = never
+    auto-evict (quarantine is already containment).
+    """
+
+    norm_max: float = 0.0
+    zmax: float = 0.0
+    cos_min: float = -1.0
+    ewma: float = 0.5
+    quarantine_at: float = 0.75
+    release_at: float = 0.25
+    evict_after: int = 0
+
+
+def screening_enabled(screen: ScreenConfig) -> bool:
+    """True when any screening statistic is armed."""
+    return screen.norm_max > 0 or screen.zmax > 0 or screen.cos_min > -1.0
+
+
+def validate_screen_config(screen: ScreenConfig) -> ScreenConfig:
+    if screen.norm_max < 0:
+        raise ValueError(f"screen norm_max must be >= 0, got {screen.norm_max}")
+    if screen.zmax < 0:
+        raise ValueError(f"screen zmax must be >= 0, got {screen.zmax}")
+    if not -1.0 <= screen.cos_min <= 1.0:
+        raise ValueError(
+            f"screen cos_min must be in [-1, 1], got {screen.cos_min}"
+        )
+    if not 0.0 < screen.ewma <= 1.0:
+        raise ValueError(f"screen ewma must be in (0, 1], got {screen.ewma}")
+    if not 0.0 <= screen.release_at <= screen.quarantine_at <= 1.0:
+        raise ValueError(
+            "screen thresholds must satisfy 0 <= release_at <= "
+            f"quarantine_at <= 1, got release_at={screen.release_at} "
+            f"quarantine_at={screen.quarantine_at}"
+        )
+    if screen.evict_after < 0:
+        raise ValueError(
+            f"screen evict_after must be >= 0, got {screen.evict_after}"
+        )
+    return screen
+
+
+@dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
     """Per-client local optimizer.
 
@@ -201,11 +278,33 @@ class SimConfig:
     # Extra sampler seed (folded with data.seed so two sim runs over the
     # same data can draw different cohort sequences).
     seed: int = 0
+    # Adversarial-participant axis (fedtpu.sim.adversary): this fraction of
+    # the simulated population (or of num_clients on the resident engine)
+    # is seeded Byzantine — their client ids are a deterministic function
+    # of (data.seed, sim.seed), so attack runs replay bit-identically.
+    malicious_fraction: float = 0.0
+    # What the attackers DO, as an attack spec
+    # "kind[:key=val,...]": sign_flip | scale:factor=F | noise:std=S |
+    # label_flip:offset=K, with shared options p= (per-round fire
+    # probability), rounds=lo-hi (half-open round window) and collude=1
+    # (colluding-cohort mode: one shared draw/noise vector for the whole
+    # malicious set — the coordinated attack that defeats distance-based
+    # defenses like krum when uncoordinated noise would not).
+    attack: str = "sign_flip"
 
 
 def validate_sim_config(fed: "FedConfig") -> None:
     """Raise on inconsistent sim settings (cheap, before any build work)."""
     sim = fed.sim
+    if not 0.0 <= sim.malicious_fraction < 1.0:
+        raise ValueError(
+            f"sim.malicious_fraction must be in [0, 1), got "
+            f"{sim.malicious_fraction}"
+        )
+    if sim.malicious_fraction > 0:
+        from fedtpu.sim.adversary import parse_attack
+
+        parse_attack(sim.attack)  # raises on a malformed spec
     if sim.population <= 0:
         return
     if sim.population < fed.num_clients:
@@ -348,6 +447,13 @@ class FedConfig:
     # SimConfig / fedtpu.sim. num_clients doubles as the COHORT size when
     # sim.population > 0 — the engine's device buffers stay that size.
     sim: SimConfig = dataclasses.field(default_factory=SimConfig)
+    # Fused update screening + reputation/quarantine (ScreenConfig;
+    # docs/FAULT_TOLERANCE.md). Off by default (all thresholds disarmed) —
+    # arming any statistic turns on per-round row rejection and, on the
+    # distributed server, the suspicion EWMA -> quarantine -> evict
+    # escalation. Unlike the robust aggregators this composes with
+    # server_pipeline='stream' and with aggregator='mean'.
+    screen: ScreenConfig = dataclasses.field(default_factory=ScreenConfig)
 
 
 def resolve_server_pipeline(fed: FedConfig) -> str:

@@ -98,6 +98,11 @@ class Federation:
                 f"unknown delta_layout {cfg.fed.delta_layout!r}; "
                 "have per_leaf | flat"
             )
+        if not 0.0 <= cfg.fed.sim.malicious_fraction < 1.0:
+            raise ValueError(
+                f"sim.malicious_fraction must be in [0, 1), got "
+                f"{cfg.fed.sim.malicious_fraction}"
+            )
         validate_telemetry_mode(cfg.fed.telemetry)
         shape, n_classes = dataset_info(cfg.data.dataset)
         if cfg.num_classes != n_classes:
@@ -162,6 +167,42 @@ class Federation:
             raise ValueError(f"unknown partition {cfg.data.partition}")
         self.client_idx, self.client_mask = idx, mask
         self.weights = jnp.asarray(partition.shard_sizes(mask))
+
+        # Seeded adversarial participants (fedtpu.sim.adversary; the
+        # SimConfig.malicious_fraction axis). On the resident engine the
+        # seat IS the client, so the attacker mask is static; SimFederation
+        # re-derives the per-seat mask from each round's cohort ids.
+        # label_flip is a DATA attack: the attackers' example labels are
+        # poisoned here on the host and the jitted program is unchanged.
+        self._attack_plan = None
+        self._attack_seats = None
+        if cfg.fed.sim.malicious_fraction > 0:
+            from fedtpu.sim import adversary
+
+            plan = adversary.parse_attack(cfg.fed.sim.attack)
+            self._attack_plan = plan
+            if mesh is not None:
+                raise NotImplementedError(
+                    "sim.malicious_fraction does not compose with a mesh "
+                    "yet (the attack mask is not threaded through "
+                    "shard_map); run the adversarial scenario single-chip"
+                )
+            if cfg.fed.sim.population <= 0:
+                amask = adversary.attacker_mask(
+                    n, cfg.fed.sim.malicious_fraction,
+                    cfg.data.seed + cfg.fed.sim.seed + plan.seed,
+                )
+                self.attacker_clients = amask
+                if plan.kind == "label_flip":
+                    # Static data poisoning: p/rounds windows do not apply
+                    # (the shard is poisoned for the whole run).
+                    labels = adversary.flip_labels(
+                        labels, idx, mask, amask, plan.label_offset,
+                        cfg.num_classes,
+                    )
+                    self.labels = labels
+                else:
+                    self._attack_seats = amask.astype(np.float32)
 
         sample = jnp.zeros((1,) + tuple(images.shape[1:]), jnp.float32)
         self.state: FederatedState = init_state(
@@ -428,6 +469,10 @@ class Federation:
             step_mask=jnp.asarray(step_mask),
             weights=self.weights,
             alive=jnp.asarray(self._alive_for_round(round_idx)),
+            attack_seats=(
+                jnp.asarray(self._attack_seats)
+                if self._attack_seats is not None else ()
+            ),
         )
 
     @property
@@ -494,6 +539,10 @@ class Federation:
             self._round_host = r + 1
             return metrics
         d_images, d_labels, d_idx, d_mask = self._ensure_device_data()
+        extra = (
+            (jnp.asarray(self._attack_seats),)
+            if self._attack_seats is not None else ()
+        )
         self._state, metrics = self._data_step(
             self._state,
             d_images,
@@ -503,6 +552,7 @@ class Federation:
             self.weights,
             self._placed(self._alive_for_round(r), sharded=True),
             self._data_key,
+            *extra,
         )
         self._round_host = r + 1
         return metrics
@@ -562,6 +612,10 @@ class Federation:
                 from jax.sharding import PartitionSpec as P
 
                 alive_dev = _put(alive, self.mesh, P(None, self.cfg.mesh_axis))
+            extra = (
+                (jnp.asarray(self._attack_seats),)
+                if self._attack_seats is not None else ()
+            )
             self._state, metrics = self._multi_step(num_rounds)(
                 self._state,
                 d_images,
@@ -571,6 +625,7 @@ class Federation:
                 self.weights,
                 alive_dev,
                 self._data_key,
+                *extra,
             )
         self._round_host = r + num_rounds
         self.status.update(round=r + num_rounds, phase="idle")
@@ -589,10 +644,14 @@ class Federation:
     ) -> RoundMetrics:
         if num_rounds is None:
             num_rounds = self.cfg.fed.num_rounds
+        from fedtpu.config import screening_enabled
+
         metrics = None
         self.eval_history = []
+        screen_on = screening_enabled(self.cfg.fed.screen)
         for r in range(num_rounds):
             t0 = time.time()
+            ridx = self._round_number()
             metrics = self.step()
             rec = {
                 "loss": metrics.loss,
@@ -615,6 +674,39 @@ class Federation:
                 "fedtpu_round_wall_seconds",
                 "per-round host wall time (dispatch + sync)",
             ).observe(rec["round_s"])
+            if screen_on:
+                # The run() loop already syncs per round (worst_client_loss
+                # above), so reading the verdict mask costs nothing extra.
+                n_screened = int(np.sum(np.asarray(metrics.screened)))
+                rec["screened"] = n_screened
+                if n_screened:
+                    self.telemetry.counter(
+                        "fedtpu_screening_rejected_total",
+                        "client rows rejected by the fused screening "
+                        "stage, by surface",
+                        labels={"surface": "engine"},
+                    ).inc(n_screened)
+            if self._attack_plan is not None:
+                from fedtpu.sim import adversary
+
+                if self._attack_seats is not None:
+                    fired = adversary.fires_this_round(
+                        self._attack_plan, self._attack_seats, ridx
+                    )
+                    n_fired = int(fired.sum())
+                else:  # label_flip: statically poisoned shards train every round
+                    n_fired = int(
+                        getattr(self, "attacker_clients",
+                                np.zeros(0, bool)).sum()
+                    )
+                rec["attackers_fired"] = n_fired
+                if n_fired:
+                    self.telemetry.counter(
+                        "fedtpu_attack_injected_total",
+                        "model/data-level attacks executed by seeded "
+                        "adversarial clients, by kind",
+                        labels={"kind": self._attack_plan.kind},
+                    ).inc(n_fired)
             if eval_every and (r + 1) % eval_every == 0 and eval_data is not None:
                 te_loss, te_acc = self.evaluate(*eval_data)
                 rec["test_loss"], rec["test_acc"] = te_loss, te_acc

@@ -15,6 +15,7 @@ runs under ``shard_map`` with the vmap axis sharded and the mean becoming a
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
@@ -22,12 +23,40 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from fedtpu.config import RoundConfig
+from fedtpu.config import RoundConfig, screening_enabled, validate_screen_config
 from fedtpu.core import optim
 from fedtpu.core.client import ClientOutput, make_local_update
 from fedtpu.utils import trees
 
 Pytree = Any
+
+log = logging.getLogger("fedtpu.round")
+
+# Aggregators already warned about ignoring example-count weights (warn
+# ONCE per process per aggregator — the message is for operators reading a
+# startup log, not a per-round nag).
+_WEIGHTED_ROBUST_WARNED = set()
+
+
+def warn_weighted_robust(aggregator: str) -> bool:
+    """Robust aggregators deliberately ignore ``weighted=True`` example
+    counts (a count-weighted robust statistic would hand adversaries their
+    influence back through inflated self-reported counts) — but silently,
+    which reads as a bug to an operator who set ``weighted=True``. Say it
+    once, loudly; callers also stamp a ``weights_ignored`` flag on round
+    records. Returns True when the combination applies."""
+    if aggregator == "mean":
+        return False
+    if aggregator not in _WEIGHTED_ROBUST_WARNED:
+        _WEIGHTED_ROBUST_WARNED.add(aggregator)
+        log.warning(
+            "aggregator=%r ignores example-count weights (weighted=True has "
+            "no effect on the combine): robust statistics weight clients "
+            "uniformly by design — self-reported counts are an adversary's "
+            "influence knob. Set weighted=False to silence this.",
+            aggregator,
+        )
+    return True
 
 
 class FederatedState(NamedTuple):
@@ -83,6 +112,10 @@ class RoundMetrics(NamedTuple):
     num_active: jnp.ndarray
     update_norm: jnp.ndarray
     per_client_loss: jnp.ndarray
+    # ``[clients]`` bool: rows REJECTED by the fused screening stage this
+    # round (always all-False when screening is off). Sharded like
+    # per_client_loss on a mesh.
+    screened: jnp.ndarray = ()
 
 
 class RoundBatch(NamedTuple):
@@ -101,6 +134,12 @@ class RoundBatch(NamedTuple):
     step_mask: jnp.ndarray
     weights: jnp.ndarray
     alive: jnp.ndarray
+    # ``[clients]`` f32/bool attacker-seat mask for the seeded adversarial
+    # harness (fedtpu.sim.adversary): 1 = this SEAT currently hosts a
+    # malicious client. ``()`` (default) = no attack plumbing — the round
+    # step only reads it when the config arms an attack
+    # (``sim.malicious_fraction > 0``), so benign programs are unchanged.
+    attack_seats: Any = ()
 
 
 def init_state(
@@ -167,6 +206,15 @@ def _robust_over_clients(
     per-client delta tree per device; fine at CNN scale, and the price of a
     true global median (a mean can psum partial sums, a median cannot).
     """
+    if aggregator == "trimmed_mean" and trim == 0.0:
+        # trim 0 trims nothing: route through the EXACT uniform-mean ops so
+        # the result is BIT-IDENTICAL to aggregator='mean' with
+        # weighted=False (pinned in tests/test_robust_agg.py) — the
+        # quantile-band formulation reduces the same values in a different
+        # op order and drifts in the last ulp.
+        return _mean_over_clients(
+            stacked, (alive_w > 0).astype(jnp.float32), axis_name
+        )[0]
     total = jnp.sum(alive_w)
     if axis_name is not None:
         total = jax.lax.psum(total, axis_name)
@@ -415,6 +463,26 @@ def make_round_step(
             f"unknown aggregator {cfg.fed.aggregator!r}; "
             "have mean | median | trimmed_mean | krum"
         )
+    if cfg.fed.weighted:
+        warn_weighted_robust(cfg.fed.aggregator)
+    # Fused update screening (ScreenConfig; one stats pass over the flat
+    # [clients, P] buffer, rejected rows drop out through the agg mask).
+    screen = (
+        validate_screen_config(cfg.fed.screen)
+        if screening_enabled(cfg.fed.screen) else None
+    )
+    # Seeded adversarial harness (fedtpu.sim.adversary): the attack PLAN is
+    # static config; WHICH seats are malicious arrives per round through
+    # batch.attack_seats (dynamic under cohort swapping). label_flip acts at
+    # the data level (host-side label mutation in the engine) — no delta
+    # transform here.
+    attack_plan = None
+    if cfg.fed.sim.malicious_fraction > 0:
+        from fedtpu.sim.adversary import parse_attack
+
+        attack_plan = parse_attack(cfg.fed.sim.attack)
+        if attack_plan.kind == "label_flip":
+            attack_plan = None
     if cfg.fed.aggregator != "mean":
         if compressor is not None:
             # Top-k deltas are zero outside each client's own top coordinates,
@@ -533,6 +601,59 @@ def make_round_step(
 
             flat_layout = flat_ops.make_layout(state.params)
             deltas = flat_ops.pack_stacked(flat_layout, deltas)
+        # Model-level adversaries (fedtpu.sim.adversary): malicious seats
+        # replace their honest delta with the attacked one BEFORE the codec
+        # — the attacker follows the protocol, only its update is hostile.
+        # Decisions (round window, per-round fire probability, colluding
+        # draws) are pure functions of (plan seed, round_idx) via jax.random
+        # — deterministic, so attack runs replay bit-identically from seed.
+        atk_fire = None
+        if attack_plan is not None and not isinstance(
+            batch.attack_seats, tuple
+        ):
+            from fedtpu.sim.adversary import attack_fire_mask
+
+            atk_fire = attack_fire_mask(
+                attack_plan, batch.attack_seats, state.round_idx, n
+            )
+            coef = jnp.where(
+                atk_fire, jnp.float32(attack_plan.coef), jnp.float32(1.0)
+            )
+
+            def poison(x):
+                c = coef.reshape((-1,) + (1,) * (x.ndim - 1))
+                return (x.astype(jnp.float32) * c).astype(x.dtype)
+
+            if attack_plan.coef != 1.0:
+                deltas = jax.tree.map(poison, deltas)
+            if attack_plan.kind == "noise":
+                nkey = jax.random.fold_in(
+                    jax.random.PRNGKey(attack_plan.seed ^ 0x4015E5),
+                    state.round_idx,
+                )
+                leaves, treedef = jax.tree_util.tree_flatten(deltas)
+                keys = jax.random.split(nkey, max(len(leaves), 1))
+
+                def noisy(x, k):
+                    # Colluding mode: ONE shared noise vector for the whole
+                    # malicious set (a consistent fake cluster — the attack
+                    # that defeats distance-based selection); otherwise
+                    # independent per-seat draws.
+                    shape = x.shape[1:] if attack_plan.collude else x.shape
+                    nz = (
+                        jax.random.normal(k, shape, jnp.float32)
+                        * attack_plan.std
+                    )
+                    nz = jnp.broadcast_to(nz, x.shape)
+                    m = atk_fire.reshape((-1,) + (1,) * (x.ndim - 1))
+                    return jnp.where(
+                        m, (x.astype(jnp.float32) + nz).astype(x.dtype), x
+                    )
+
+                deltas = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [noisy(x, k) for x, k in zip(leaves, keys)],
+                )
         comp_state = state.comp_state
         if compressor is not None:
             if flat_mode:
@@ -562,6 +683,30 @@ def make_round_step(
         stats_delta = jax.tree.map(
             lambda c, g: c - g[None], out.batch_stats, state.batch_stats
         )
+        if atk_fire is not None and attack_plan.coef != 1.0:
+            # The attacker poisons its WHOLE submission coherently (krum
+            # selects params + stats jointly, so a clean stats tree would
+            # leak the honest update).
+            stats_delta = jax.tree.map(poison, stats_delta)
+        # Fused screening: one stats pass over the flat rows; rejected rows
+        # leave the combine through the same zero-weight mask dead clients
+        # use, so the weighted mean / robust aggregators are untouched
+        # bit-cleanly for the survivors.
+        screened = jnp.zeros((n,), bool)
+        if screen is not None:
+            from fedtpu.ops import flat as screen_flat_ops
+
+            rows = (
+                deltas if flat_mode
+                else screen_flat_ops.pack_stacked(
+                    screen_flat_ops.make_layout(state.params), deltas
+                )
+            )
+            keep, _ = screen_flat_ops.screen_rows(
+                rows, agg_w, screen.norm_max, screen.zmax, screen.cos_min
+            )
+            screened = (agg_w > 0) & ~keep
+            agg_w = agg_w * keep.astype(agg_w.dtype)
         if cfg.fed.dp_clip_norm > 0:
             deltas = _dp_clip(deltas, cfg.fed.dp_clip_norm)
         if cfg.fed.aggregator == "krum":
@@ -618,6 +763,7 @@ def make_round_step(
             num_active=n_alive,
             update_norm=trees.tree_norm(mean_delta),
             per_client_loss=out.loss * alive_f,
+            screened=screened,
         )
         new_state = FederatedState(
             params=new_params,

@@ -211,8 +211,10 @@ def make_data_round_step(
         weights: jnp.ndarray,
         alive: jnp.ndarray,
         data_key: jax.Array,
+        attack_seats=None,
     ) -> Tuple[FederatedState, RoundMetrics]:
         n = idx.shape[0]
+        atk = () if attack_seats is None else attack_seats
         rng = None
         if shuffle:
             rng = jax.random.fold_in(data_key, state.round_idx)
@@ -228,7 +230,7 @@ def make_data_round_step(
             takes = take.reshape((n, steps, batch_size))
             batch = RoundBatch(
                 x=takes, y=takes, step_mask=step_mask, weights=weights,
-                alive=alive,
+                alive=alive, attack_seats=atk,
             )
             return base(state, batch, images, labels)
         # Dataset may be stored flat ([N, H*W*C] — the TPU-friendly layout,
@@ -237,7 +239,8 @@ def make_data_round_step(
         x = images[take].reshape((n, steps, batch_size) + tail)
         y = labels[take].reshape((n, steps, batch_size))
         batch = RoundBatch(
-            x=x, y=y, step_mask=step_mask, weights=weights, alive=alive
+            x=x, y=y, step_mask=step_mask, weights=weights, alive=alive,
+            attack_seats=atk,
         )
         return base(state, batch)
 
@@ -250,8 +253,10 @@ def make_data_round_step(
         weights: jnp.ndarray,
         alive: jnp.ndarray,
         data_key: jax.Array,
+        attack_seats=None,
     ) -> Tuple[FederatedState, RoundMetrics]:
         n = mask.shape[0]
+        atk = () if attack_seats is None else attack_seats
         rng = (
             jax.random.fold_in(data_key, state.round_idx) if shuffle else None
         )
@@ -262,7 +267,8 @@ def make_data_round_step(
             images, labels, off, steps, batch_size, shape, stream=stream
         )
         batch = RoundBatch(
-            x=x, y=y, step_mask=step_mask, weights=weights, alive=alive
+            x=x, y=y, step_mask=step_mask, weights=weights, alive=alive,
+            attack_seats=atk,
         )
         if stream:
             return base(state, batch, images, labels)
@@ -360,10 +366,13 @@ def make_multi_round_step(
         weights: jnp.ndarray,
         alive: jnp.ndarray,
         data_key: jax.Array,
+        attack_seats=None,
     ) -> Tuple[FederatedState, RoundMetrics]:
+        # attack_seats is per-BLOCK static (the fused block runs one cohort;
+        # per-round fire decisions still vary inside the scan via round_idx).
         def scan_body(st, alive_r):
             return body(st, images, labels, idx, mask, weights, alive_r,
-                        data_key)
+                        data_key, attack_seats)
 
         return jax.lax.scan(scan_body, state, alive, length=num_rounds)
 
@@ -410,10 +419,12 @@ def _shard_wrap(body, cfg: RoundConfig, mesh, alive_ndim: int, donate: bool,
         ),
         out_specs=(
             state_specs(axis),
-            # Scalar metrics replicate; per_client_loss shards on its client
-            # axis — axis 0 for one round, axis 1 when the scan stacks [R, n].
+            # Scalar metrics replicate; per_client_loss and the screening
+            # mask shard on their client axis — axis 0 for one round,
+            # axis 1 when the scan stacks [R, n].
             RoundMetrics(
                 P(), P(), P(), P(),
+                P(axis) if alive_ndim == 1 else P(None, axis),
                 P(axis) if alive_ndim == 1 else P(None, axis),
             ),
         ),

@@ -23,6 +23,16 @@ Fault kinds:
 - ``kill``    — SIGKILL the *current process* (use ``max=1`` for the
   one-shot mid-round primary kill of the failover drills).
 
+Model-level Byzantine attacks (``ATTACK_KINDS``: ``sign_flip`` |
+``scale:factor=F`` | ``noise:std=S[,collude=1]`` | ``label_flip:offset=K``)
+ride the same schedule/DSL but are a separate fault CLASS: they are
+consulted by :class:`fedtpu.transport.federation.LocalTrainer` via
+:meth:`FaultSchedule.decide_attack` (pseudo-RPC ``Attack``, peer = the
+client's own address) and executed against the model update itself, never
+by the wire interceptors; they count into
+``fedtpu_attack_injected_total{kind}``. See docs/FAULT_TOLERANCE.md
+§Threat model.
+
 Determinism: each (rule, rpc, peer) triple keeps its own draw counter, and
 the n-th draw fires iff ``crc32(f"{seed}|{rule}|{rpc}|{peer}|{n}") / 2^32 <
 p``. The decision therefore depends only on the seed and on that peer's own
@@ -61,11 +71,23 @@ from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger("fedtpu.chaos")
 
-KINDS = ("delay", "drop", "error", "corrupt", "kill")
-# The service's RPC surface plus the engine loops' pseudo-RPC.
+WIRE_KINDS = ("delay", "drop", "error", "corrupt", "kill")
+# Model-level Byzantine attacks (the well-formed-but-malicious fault
+# class): executed inside LocalTrainer against the update itself, never by
+# the wire interceptors. Keyed on the pseudo-RPC "Attack" with peer = the
+# client's own serving address; consulted once per training round via
+# decide_attack(). sign_flip negates the honest delta, scale boosts it by
+# `factor`, noise adds Gaussian noise of std `std` (a shared draw when
+# collude=1 — the coordinated fake cluster), label_flip shifts the round's
+# training labels by `offset` classes. The simulated twin is
+# fedtpu.sim.adversary (SimConfig.malicious_fraction).
+ATTACK_KINDS = ("sign_flip", "scale", "noise", "label_flip")
+KINDS = WIRE_KINDS + ATTACK_KINDS
+# The service's RPC surface plus the engine loops' pseudo-RPC and the
+# model-level attack consult.
 RPC_NAMES = (
     "StartTrain", "SendModel", "HeartBeat", "CheckIfPrimaryUp",
-    "FetchModel", "Round", "*",
+    "FetchModel", "Round", "Attack", "*",
 )
 
 
@@ -95,6 +117,19 @@ class FaultRule:
     # transients" pairs consec < retry attempts. None = unbounded
     # (outage-style rules).
     max_consecutive: Optional[int] = None
+    # Attack-kind parameters (ATTACK_KINDS only; ignored by wire kinds).
+    factor: float = 10.0      # scale: boost on the honest delta
+    noise_std: float = 1.0    # noise: Gaussian std
+    label_offset: int = 1     # label_flip: class shift (mod num_classes)
+    # Colluding-cohort mode: every attacker consulting this rule shares ONE
+    # per-round draw (and one noise vector) instead of independent ones —
+    # a consistent fake cluster, the shape that defeats distance-based
+    # selection (krum) where independent noise would not.
+    collude: bool = False
+
+    @property
+    def is_attack(self) -> bool:
+        return self.kind in ATTACK_KINDS
 
     def validate(self) -> "FaultRule":
         if self.kind not in KINDS:
@@ -105,6 +140,20 @@ class FaultRule:
             raise ValueError(
                 f"unknown rpc {self.rpc!r}; have {'|'.join(RPC_NAMES)}"
             )
+        if self.is_attack and self.rpc not in ("Attack", "*"):
+            raise ValueError(
+                f"attack kind {self.kind!r} applies to the model update, "
+                "not an RPC — leave rpc unset (it keys on the pseudo-RPC "
+                "'Attack')"
+            )
+        if self.kind == "scale" and self.factor == 0.0:
+            raise ValueError("scale attack factor must be nonzero")
+        if self.noise_std < 0:
+            raise ValueError(
+                f"noise std must be >= 0, got {self.noise_std}"
+            )
+        if self.kind == "label_flip" and self.label_offset == 0:
+            raise ValueError("label_flip offset must be nonzero")
         if not 0.0 <= self.p <= 1.0:
             raise ValueError(f"fault p must be in [0, 1], got {self.p}")
         if self.delay_s < 0:
@@ -151,6 +200,11 @@ class FaultSchedule:
 
     # ---------------------------------------------------------- decision
     def _matches(self, rule: FaultRule, rpc: str, peer: str) -> bool:
+        # Kind classes never cross: a wildcard wire rule (error@*) must not
+        # fire on the model-update consult, and an attack rule must never
+        # inject into a wire interceptor.
+        if rule.is_attack != (rpc == "Attack"):
+            return False
         if rule.rpc != "*" and rule.rpc != rpc:
             return False
         if rule.peer != "*" and rule.peer != peer:
@@ -206,14 +260,26 @@ class FaultSchedule:
             rule.kind, rpc, f" -> {peer}" if peer != "*" else "", self._round,
         )
         if self._metrics is not None:
-            self._metrics.counter(
-                "fedtpu_chaos_injected_total",
-                "faults injected by the chaos schedule, by kind and rpc",
-                labels={"kind": rule.kind, "rpc": rpc},
-            ).inc()
+            if rule.is_attack:
+                # Byzantine attacks are their own fault class — folding
+                # them into the wire-chaos counter would hide the regime a
+                # soak is actually in (satellite of the Byzantine PR).
+                self._metrics.counter(
+                    "fedtpu_attack_injected_total",
+                    "model/data-level attacks executed by seeded "
+                    "adversarial clients, by kind",
+                    labels={"kind": rule.kind},
+                ).inc()
+            else:
+                self._metrics.counter(
+                    "fedtpu_chaos_injected_total",
+                    "faults injected by the chaos schedule, by kind and rpc",
+                    labels={"kind": rule.kind, "rpc": rpc},
+                ).inc()
         if self._flight is not None:
             self._flight.record(
-                "chaos", fault=rule.kind, rpc=rpc, peer=peer,
+                "attack" if rule.is_attack else "chaos",
+                fault=rule.kind, rpc=rpc, peer=peer,
                 round=self._round,
             )
 
@@ -234,6 +300,14 @@ class FaultSchedule:
                 opts.append(f"max={r.max_injections}")
             if r.max_consecutive is not None:
                 opts.append(f"consec={r.max_consecutive}")
+            if r.kind == "scale":
+                opts.append(f"factor={r.factor:g}")
+            elif r.kind == "noise":
+                opts.append(f"std={r.noise_std:g}")
+            elif r.kind == "label_flip":
+                opts.append(f"offset={r.label_offset}")
+            if r.collude:
+                opts.append("collude=1")
             parts.append(f"{r.kind}@{r.rpc}:{','.join(opts)}")
         return f"seed={self.seed} " + "; ".join(parts)
 
@@ -265,6 +339,53 @@ class FaultSchedule:
                                 "chaos: injected error")
         elif rule.kind == "kill":
             self._kill(rpc)
+
+    def decide_attack(self, client: str, round_idx: Optional[int] = None):
+        """Model-level attack consult: the first ATTACK_KINDS rule that
+        fires for this client's training round (None = train honestly).
+        Called by :class:`fedtpu.transport.federation.LocalTrainer` once
+        per StartTrain, with ``client`` = its own serving address and
+        ``round_idx`` = its local round (keys ``rounds=`` windows). Same
+        deterministic draw counters as :meth:`decide` — an attack schedule
+        replays bit-identically from its seed."""
+        if round_idx is not None:
+            self.set_round(round_idx)
+        return self.decide("Attack", client)
+
+    def apply_attack_delta(self, rule: FaultRule, delta, peer: str,
+                           round_idx: int):
+        """Transform a host-side delta pytree per a fired delta-level
+        attack rule (sign_flip | scale | noise). Noise draws are seeded
+        from (schedule seed, peer, round) — or (schedule seed, round) in
+        colluding mode, so every colluder submits the SAME noise vector —
+        making the attacked payload a pure function of the spec."""
+        import jax
+        import numpy as np
+
+        coef = {"sign_flip": -1.0, "scale": rule.factor}.get(rule.kind, 1.0)
+        if coef != 1.0:
+            delta = jax.tree.map(
+                lambda x: (np.asarray(x, np.float32) * coef).astype(
+                    np.asarray(x).dtype
+                ),
+                delta,
+            )
+        if rule.kind == "noise":
+            who = "*" if rule.collude else peer
+            seed = zlib.crc32(
+                f"{self.seed}|attack-noise|{who}|{round_idx}".encode()
+            )
+            rng = np.random.default_rng(seed)
+            delta = jax.tree.map(
+                lambda x: (
+                    np.asarray(x, np.float32)
+                    + rng.normal(0.0, rule.noise_std, np.shape(x)).astype(
+                        np.float32
+                    )
+                ).astype(np.asarray(x).dtype),
+                delta,
+            )
+        return delta
 
     def tick_round(self, round_idx: int) -> None:
         """Engine-loop hook for the RPC-less CLIs (``run``/``train``): one
@@ -458,10 +579,19 @@ def _parse_dsl(spec: str) -> FaultSchedule:
                 fields["max_injections"] = val
             elif key == "consec":
                 fields["max_consecutive"] = val
+            elif key == "factor":
+                fields["factor"] = val
+            elif key == "std":
+                fields["noise_std"] = val
+            elif key == "offset":
+                fields["label_offset"] = val
+            elif key == "collude":
+                fields["collude"] = val not in ("0", "false", "False", "")
             else:
                 raise ValueError(
                     f"unknown chaos option {key!r} in {part!r}; have "
-                    "p|peer|delay|code|rounds|max|consec|seed"
+                    "p|peer|delay|code|rounds|max|consec|seed|"
+                    "factor|std|offset|collude"
                 )
         rules.append(_rule_from(fields))
     if not rules:
@@ -470,6 +600,10 @@ def _parse_dsl(spec: str) -> FaultSchedule:
 
 
 def _rule_from(fields: dict) -> FaultRule:
+    # Attack kinds key on the pseudo-RPC "Attack"; a bare `sign_flip:p=1`
+    # spec normalizes there so authors never have to spell it.
+    if fields.get("kind") in ATTACK_KINDS and fields.get("rpc", "*") == "*":
+        fields["rpc"] = "Attack"
     if "rounds" in fields and not isinstance(fields["rounds"], (tuple, list)):
         lo, dash, hi = str(fields["rounds"]).partition("-")
         fields["rounds"] = (int(lo), int(hi)) if dash else (
@@ -477,12 +611,14 @@ def _rule_from(fields: dict) -> FaultRule:
         )
     if "rounds" in fields and fields["rounds"] is not None:
         fields["rounds"] = tuple(int(x) for x in fields["rounds"])
-    for key in ("p", "delay_s"):
+    for key in ("p", "delay_s", "factor", "noise_std"):
         if key in fields:
             fields[key] = float(fields[key])
-    for key in ("max_injections", "max_consecutive"):
+    for key in ("max_injections", "max_consecutive", "label_offset"):
         if key in fields and fields[key] is not None:
             fields[key] = int(fields[key])
+    if "collude" in fields:
+        fields["collude"] = bool(fields["collude"])
     unknown = set(fields) - {
         f.name for f in dataclasses.fields(FaultRule)
     }

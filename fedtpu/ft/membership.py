@@ -66,6 +66,14 @@ class MembershipTable:
         self._version = 0
         self._lock = threading.Lock()
         self._metrics = metrics
+        # Reputation (Byzantine screening, docs/FAULT_TOLERANCE.md):
+        # per-client suspicion score — an EWMA of screening verdicts fed by
+        # the coordinator (observe_screening) — and, for quarantined
+        # members, the count of consecutive quarantined rounds (absent =
+        # not quarantined). Both replicate with the roster so a promoted
+        # backup inherits who is suspect, not just who is a member.
+        self._suspicion: Dict[str, float] = {}
+        self._quarantined: Dict[str, int] = {}
         for c in clients:
             if c in self._seat:
                 raise ValueError(f"duplicate client id {c!r}")
@@ -90,6 +98,12 @@ class MembershipTable:
             "fedtpu_membership_version",
             "membership epoch: bumped by every admit/evict transition",
         ).set(self.version)
+        with self._lock:
+            n_quarantined = len(self._quarantined)
+        self._metrics.gauge(
+            "fedtpu_membership_quarantined",
+            "members currently quarantined (served but updates ignored)",
+        ).set(n_quarantined)
 
     def _unknown(self, op: str, client: str) -> None:
         log.info("membership: %s for non-member %s ignored", op, client)
@@ -197,6 +211,8 @@ class MembershipTable:
             seat = self._seat.pop(client, None)
             if seat is not None:
                 del self._alive[client]
+                self._suspicion.pop(client, None)
+                self._quarantined.pop(client, None)
                 heapq.heappush(self._free, seat)
                 self._version += 1
                 version = self._version
@@ -251,15 +267,105 @@ class MembershipTable:
         with self._lock:
             return self._alive.get(client, False)
 
+    # --------------------------------------------------------- reputation
+    def observe_screening(self, client: str, flagged: bool,
+                          ewma: float = 0.5) -> float:
+        """Fold one screening verdict into the member's suspicion EWMA
+        (``s' = (1-ewma)*s + ewma*flagged``) and return the new score.
+        Non-members log-and-ignore (a late verdict for an evicted client
+        is ordinary under churn) and read as 0."""
+        with self._lock:
+            if client not in self._seat:
+                member = False
+            else:
+                member = True
+                s = self._suspicion.get(client, 0.0)
+                s = (1.0 - ewma) * s + ewma * (1.0 if flagged else 0.0)
+                self._suspicion[client] = s
+        if not member:
+            self._unknown("observe_screening", client)
+            return 0.0
+        return s
+
+    def suspicion(self, client: str) -> float:
+        with self._lock:
+            return self._suspicion.get(client, 0.0)
+
+    def suspicion_map(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._suspicion)
+
+    def quarantine(self, client: str) -> bool:
+        """Quarantine a member: still served (broadcasts, StartTrain — it
+        keeps generating screening evidence and can redeem itself) but its
+        updates are ignored unconditionally by the round loop. Returns
+        False for non-members or already-quarantined members."""
+        with self._lock:
+            if client not in self._seat or client in self._quarantined:
+                fresh = False
+            else:
+                fresh = True
+                self._quarantined[client] = 0
+        if not fresh:
+            if not self.is_member(client):
+                self._unknown("quarantine", client)
+            return False
+        log.warning("membership: client %s QUARANTINED (suspicion %.3f)",
+                    client, self.suspicion(client))
+        self._count(
+            "fedtpu_membership_quarantine_total",
+            "members placed in quarantine by the screening reputation "
+            "escalation (dedicated counter — not a transient failure)",
+        )
+        self._gauges()
+        return True
+
+    def release(self, client: str) -> bool:
+        """Release a quarantined member (suspicion decayed below the
+        release threshold). Returns False if it was not quarantined."""
+        with self._lock:
+            present = self._quarantined.pop(client, None) is not None
+        if present:
+            log.info("membership: client %s released from quarantine "
+                     "(suspicion %.3f)", client, self.suspicion(client))
+            self._gauges()
+        return present
+
+    def is_quarantined(self, client: str) -> bool:
+        with self._lock:
+            return client in self._quarantined
+
+    def quarantined_clients(self) -> List[str]:
+        with self._lock:
+            return sorted(self._quarantined, key=self._seat.__getitem__)
+
+    def tick_quarantine(self, client: str) -> int:
+        """Advance a quarantined member's round count; returns the new
+        count (0 if not quarantined) — the escalation clock for
+        quarantine -> evict."""
+        with self._lock:
+            if client not in self._quarantined:
+                return 0
+            self._quarantined[client] += 1
+            return self._quarantined[client]
+
     # -------------------------------------------------------- replication
     def snapshot(self) -> dict:
-        """JSON-able roster state for the replica payload / checkpoints."""
+        """JSON-able roster state for the replica payload / checkpoints.
+        Member rows carry the reputation state too (suspicion EWMA +
+        quarantined-round count, -1 = not quarantined): a promoted backup
+        must inherit who is suspect, or a quarantined attacker would get a
+        clean slate from every failover."""
         with self._lock:
             return {
                 "version": self._version,
                 "capacity": self._capacity,
                 "members": [
-                    [c, self._seat[c], bool(self._alive[c])]
+                    [
+                        c, self._seat[c], bool(self._alive[c]),
+                        round(self._suspicion.get(c, 0.0), 6),
+                        self._quarantined.get(c, -1),
+                    ]
                     for c in sorted(self._seat, key=self._seat.__getitem__)
                 ],
             }
@@ -270,13 +376,23 @@ class MembershipTable:
         flags included, so a silently-departed client is not re-probed as
         if it were fresh). The local version never goes backwards."""
         members = snap["members"]
-        seats = [int(s) for _, s, _ in members]
+        seats = [int(row[1]) for row in members]
         if len(set(seats)) != len(seats):
             raise ValueError("membership snapshot has duplicate seats")
         capacity = max([int(snap["capacity"])] + [s + 1 for s in seats])
         with self._lock:
-            self._seat = {str(c): int(s) for c, s, _ in members}
-            self._alive = {str(c): bool(a) for c, _, a in members}
+            self._seat = {str(row[0]): int(row[1]) for row in members}
+            self._alive = {str(row[0]): bool(row[2]) for row in members}
+            # Pre-reputation snapshots (3-element rows) restore with a
+            # clean slate; 5-element rows carry suspicion + quarantine.
+            self._suspicion = {
+                str(row[0]): float(row[3])
+                for row in members if len(row) >= 5 and float(row[3]) > 0
+            }
+            self._quarantined = {
+                str(row[0]): int(row[4])
+                for row in members if len(row) >= 5 and int(row[4]) >= 0
+            }
             self._capacity = capacity
             taken = set(self._seat.values())
             self._free = [s for s in range(capacity) if s not in taken]
@@ -299,4 +415,13 @@ class MembershipTable:
                 "capacity": self._capacity,
                 "alive": [c for c in order if self._alive[c]],
                 "dead": [c for c in order if not self._alive[c]],
+                # The reputation audit surface: who is quarantined, and
+                # every nonzero suspicion score (operators watch a rising
+                # score rounds before the quarantine flips).
+                "quarantined": [c for c in order if c in self._quarantined],
+                "suspicion": {
+                    c: round(s, 4)
+                    for c, s in sorted(self._suspicion.items())
+                    if s > 0
+                },
             }

@@ -204,6 +204,113 @@ def topk_threshold(y: jnp.ndarray, fraction: float, total: int) -> Optional[jnp.
     return jax.lax.top_k(jnp.abs(y), k)[0][:, -1]
 
 
+def screen_rows(
+    rows: jnp.ndarray,
+    alive: jnp.ndarray,
+    norm_max: float = 0.0,
+    zmax: float = 0.0,
+    cos_min: float = -1.0,
+):
+    """Fused Byzantine screening over a ``[clients, P]`` flat delta buffer.
+
+    One program computes three per-row statistics and folds them into a
+    keep/reject verdict (the thresholds are STATIC — callers close over a
+    :class:`fedtpu.config.ScreenConfig`):
+
+    - ``norm``: the row's L2 norm (per-row — under the streaming server
+      pipeline this is the statistic that folds on arrival, host-side, with
+      zero extra device syncs; the fused verdict below recomputes it in the
+      same f32 math post-barrier).
+    - ``cos``: cosine of the row against the live cohort's ROBUST
+      REFERENCE DIRECTION — the mean of the norm-normalized live rows.
+      Each client contributes exactly one unit vector, so a boosted
+      update cannot drag the reference (the bounded-influence property a
+      coordinate-wise median direction would give), and for a pure
+      sign-flip minority the resultant stays exactly on the honest
+      direction; unlike the median it is one elementwise pass, not a
+      [clients, P] sort (measured 280 ms -> ~4 ms per round at densenet
+      width on CPU — the difference between failing and passing the <=1%
+      microbench gate). A sign-flipped/contrarian update scores ~-1 while
+      honest heterogeneous updates stay positive.
+    - ``z``: modified z-score of the row norm against the live cohort's
+      median/MAD (``0.6745 * (norm - median) / MAD``, Iglewicz-Hoaglin).
+      Median/MAD, not mean/std: a 30% boosted-attacker cohort inflates the
+      mean and std enough to hide itself from a classical z-score, but
+      cannot move the median while the honest majority holds. The check is
+      ONE-SIDED (``z <= zmax`` keeps): only an inflated norm can dominate
+      a combine — an unusually small update has bounded influence, and a
+      two-sided cut would reject honest low-data clients.
+
+    ``alive`` selects the rows that form the reference statistics (median
+    direction, median/MAD of norms) — already-quarantined or failed rows
+    must not pollute the reference population — but every row receives a
+    verdict against those references, so a quarantined client keeps
+    generating evidence (and can redeem itself).
+
+    Invariances (property-pinned in ``tests/test_properties.py``): the
+    per-row stats are permutation-equivariant (reordering rows reorders
+    verdicts identically — median/MAD/median-direction are order-free
+    reductions), and ``cos``/``z`` are invariant under a common positive
+    scaling of all rows, so the relative checks need no per-model
+    calibration (only ``norm_max`` is absolute by design).
+
+    Returns ``(keep, stats)``: ``keep`` bool ``[clients]`` (True = row may
+    enter the combine; a disarmed threshold never rejects), ``stats`` a
+    dict of the three f32 ``[clients]`` vectors for records/telemetry.
+    """
+    rows = rows.astype(jnp.float32)
+    live = (alive.astype(jnp.float32) > 0)
+    norms = jnp.sqrt(jnp.maximum(jnp.sum(rows * rows, axis=1), 0.0))
+    eps = jnp.float32(1e-12)
+    # Robust reference direction: resultant of the live UNIT rows (see
+    # docstring — bounded per-client influence at elementwise cost),
+    # evaluated LEAVE-ONE-OUT per row: a row's own unit vector must not
+    # vouch for it (at small cohorts self-inclusion inflates an outlier's
+    # cosine by ~1/n_live). The LOO terms are pure dot-product algebra —
+    # no second pass over the buffer.
+    unit = rows / (norms + eps)[:, None]
+    live_f = live.astype(jnp.float32)
+    ref = jnp.sum(unit * live_f[:, None], axis=0)
+    ref_sq = jnp.maximum(jnp.sum(ref * ref), 0.0)
+    d = rows @ ref                      # [n]  <row_i, ref>
+    u = d / (norms + eps)               # [n]  <unit_i, ref>
+    loo_dot = d - live_f * norms        # <row_i, ref - unit_i> for live i
+    loo_sq = jnp.maximum(ref_sq - live_f * (2.0 * u - 1.0), 0.0)
+    cos = loo_dot / (norms * jnp.sqrt(loo_sq) + eps)
+    # Modified z-score of the norms against the live median/MAD.
+    norm_med = jnp.nan_to_num(
+        jnp.nanmedian(jnp.where(live, norms, jnp.nan)), nan=0.0
+    )
+    mad = jnp.nan_to_num(
+        jnp.nanmedian(jnp.where(live, jnp.abs(norms - norm_med), jnp.nan)),
+        nan=0.0,
+    )
+    # MAD floor at 5% of the median scale: near convergence honest norms
+    # become nearly identical and a raw MAD collapses toward 0, amplifying
+    # harmless jitter into "outliers" (observed: honest evictions in the
+    # 100-round Byzantine soak). A deviation within a few percent of the
+    # cohort's scale is never evidence — an attacker must inflate its norm
+    # by a meaningful multiple, which stays hundreds of sigmas out under
+    # the floor. Scale-invariance is preserved (the floor tracks the
+    # median).
+    mad = jnp.maximum(mad, 0.05 * norm_med)
+    z = 0.6745 * (norms - norm_med) / (mad + eps)
+    keep = jnp.ones(norms.shape, bool)
+    if norm_max > 0:
+        keep = keep & (norms <= norm_max)
+    if zmax > 0:
+        keep = keep & (z <= zmax)
+    if cos_min > -1.0:
+        keep = keep & (cos >= cos_min)
+    # Degenerate cohorts keep everything the thresholds didn't reject: with
+    # <= 2 live rows the median IS the row set and MAD is 0 — the z/cos
+    # checks would reject arbitrarily. Statistics need a population.
+    n_live = jnp.sum(live.astype(jnp.int32))
+    keep = jnp.where(n_live >= 3, keep, norms <= norm_max if norm_max > 0
+                     else jnp.ones_like(keep))
+    return keep, {"norm": norms, "cos": cos, "z": z}
+
+
 def int8_scales(y: jnp.ndarray, layout: FlatLayout) -> jnp.ndarray:
     """Per-coordinate int8 scale vector reproducing the per-leaf codec
     EXACTLY: scale = max|leaf| / 127 per client per leaf, computed with one

@@ -75,7 +75,7 @@ def make_sharded_round_step(
         in_specs=(state_specs(axis), batch_specs(axis)),
         out_specs=(
             state_specs(axis),
-            RoundMetrics(P(), P(), P(), P(), P(axis)),
+            RoundMetrics(P(), P(), P(), P(), P(axis), P(axis)),
         ),
         check_vma=False,
     )

@@ -1,0 +1,217 @@
+"""Seeded adversarial participants for the simulation engines.
+
+FL_PyTorch (arXiv:2202.03099) argues the unreliable/adversarial-participant
+regime must be a first-class *simulated* scenario, not an afterthought:
+fedtpu already ships the defenses (median/trimmed_mean/krum in
+:mod:`fedtpu.core.round`, fused screening in :mod:`fedtpu.ops.flat`) but
+until this module had no attacker to exercise them. Here the malicious set
+becomes one more seeded, replayable scenario axis
+(``SimConfig.malicious_fraction`` + ``SimConfig.attack``), exactly like
+PR 5 made wire faults one (``fedtpu.ft.chaos``).
+
+Attack kinds (``SimConfig.attack`` spec, ``kind[:key=val,...]``):
+
+- ``sign_flip`` — submit the NEGATED honest delta (gradient ascent on the
+  global objective; the classic model-poisoning baseline).
+- ``scale:factor=F`` — submit the honest delta boosted by ``F`` (model
+  replacement / boosting, Bagdasaryan et al.); ``factor`` may be negative
+  to combine boosting with the sign flip.
+- ``noise:std=S`` — add Gaussian noise of std ``S`` to the honest delta
+  (a Gaussian Byzantine worker, Blanchard et al. 2017's attack model).
+- ``label_flip:offset=K`` — a DATA poisoning attack: the attacker's
+  training labels are shifted by ``K`` classes (mod num_classes). Applied
+  host-side to the attacker-owned example rows at engine construction
+  (partitions are disjoint covers, so only attacker shards are touched);
+  the jitted round program is unchanged.
+
+Shared options: ``p`` (per-round fire probability, default 1), ``rounds``
+(``lo-hi`` half-open lineage-round window), ``collude=1`` (colluding-cohort
+mode: the whole malicious set fires on ONE shared draw and — for ``noise``
+— submits ONE shared noise vector, the coordinated fake cluster that
+defeats distance-based selection like krum when independent noise would
+not), and ``seed``.
+
+Determinism contract (same as PR 5 chaos): attacker IDENTITY is a seeded
+choice over the population, and every per-round decision is a pure function
+of ``(seed, round)`` (via ``jax.random`` inside the jitted round step, via
+the same fold host-side for accounting) — the same config replays the same
+attack schedule bit-identically, which is what lets the convergence pins
+assert exact reproducibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+ATTACK_KINDS = ("sign_flip", "scale", "noise", "label_flip")
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackPlan:
+    """Parsed ``SimConfig.attack`` spec — static, closed over by the jitted
+    round step (only the per-seat attacker mask is a traced input)."""
+
+    kind: str
+    p: float = 1.0
+    factor: float = 10.0
+    std: float = 1.0
+    label_offset: int = 1
+    collude: bool = False
+    rounds: Optional[Tuple[int, int]] = None
+    seed: int = 0
+
+    @property
+    def coef(self) -> float:
+        """Multiplicative coefficient on the honest delta."""
+        if self.kind == "sign_flip":
+            return -1.0
+        if self.kind == "scale":
+            return self.factor
+        return 1.0
+
+    def validate(self) -> "AttackPlan":
+        if self.kind not in ATTACK_KINDS:
+            raise ValueError(
+                f"unknown attack kind {self.kind!r}; "
+                f"have {'|'.join(ATTACK_KINDS)}"
+            )
+        if not 0.0 < self.p <= 1.0:
+            raise ValueError(f"attack p must be in (0, 1], got {self.p}")
+        if self.std < 0:
+            raise ValueError(f"attack std must be >= 0, got {self.std}")
+        if self.kind == "scale" and self.factor == 0.0:
+            raise ValueError("attack scale factor must be nonzero")
+        if self.kind == "label_flip" and self.label_offset == 0:
+            raise ValueError("label_flip offset must be nonzero")
+        return self
+
+
+def parse_attack(spec: str) -> AttackPlan:
+    """``kind[:key=val,...]`` -> validated :class:`AttackPlan`.
+
+    Examples: ``sign_flip``, ``scale:factor=20,p=0.5``,
+    ``noise:std=2.0,collude=1``, ``label_flip:offset=3,rounds=10-50``.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty attack spec")
+    head, _, opt_str = spec.partition(":")
+    fields: dict = {"kind": head.strip()}
+    for opt in filter(None, (o.strip() for o in opt_str.split(","))):
+        key, eq, val = opt.partition("=")
+        if not eq:
+            raise ValueError(f"attack option {opt!r} is not key=value")
+        key, val = key.strip(), val.strip()
+        if key == "p":
+            fields["p"] = float(val)
+        elif key == "factor":
+            fields["factor"] = float(val)
+        elif key == "std":
+            fields["std"] = float(val)
+        elif key == "offset":
+            fields["label_offset"] = int(val)
+        elif key == "collude":
+            fields["collude"] = val not in ("0", "false", "False", "")
+        elif key == "seed":
+            fields["seed"] = int(val)
+        elif key == "rounds":
+            lo, dash, hi = val.partition("-")
+            fields["rounds"] = (
+                (int(lo), int(hi)) if dash else (int(lo), int(lo) + 1)
+            )
+        else:
+            raise ValueError(
+                f"unknown attack option {key!r} in {spec!r}; have "
+                "p|factor|std|offset|collude|rounds|seed"
+            )
+    return AttackPlan(**fields).validate()
+
+
+def choose_attackers(population: int, fraction: float, seed: int) -> np.ndarray:
+    """The seeded malicious subset: ``floor(fraction * population)`` client
+    ids drawn without replacement. Pure function of (population, fraction,
+    seed) — the identity of the adversaries replays exactly."""
+    k = int(np.floor(fraction * population))
+    if k <= 0:
+        return np.zeros((0,), np.int64)
+    rng = np.random.default_rng(seed * 9973 + 0xBAD)
+    return np.sort(rng.choice(population, size=k, replace=False)).astype(
+        np.int64
+    )
+
+
+def attacker_mask(population: int, fraction: float, seed: int) -> np.ndarray:
+    """``[population]`` bool mask over client ids (True = malicious)."""
+    mask = np.zeros((population,), bool)
+    mask[choose_attackers(population, fraction, seed)] = True
+    return mask
+
+
+def flip_labels(
+    labels: np.ndarray,
+    idx: np.ndarray,
+    mask: np.ndarray,
+    attackers: np.ndarray,
+    offset: int,
+    num_classes: int,
+) -> np.ndarray:
+    """Label-flip poisoning applied to the attacker-owned example rows.
+
+    ``idx``/``mask``: the ``[clients, shard_len]`` partition (a disjoint
+    cover, so only attacker shards change); ``attackers``: ``[clients]``
+    bool. Returns a COPY of ``labels`` with the attackers' examples shifted
+    by ``offset`` classes — the attackers then *train honestly on poisoned
+    data*, the cheapest realistic data-poisoning adversary.
+    """
+    out = np.asarray(labels).copy()
+    for c in np.flatnonzero(np.asarray(attackers, bool)):
+        own = idx[c][mask[c]]
+        if len(own):
+            out[own] = (out[own] + offset) % num_classes
+    return out
+
+
+def attack_fire_mask(plan: AttackPlan, attack_seats, round_idx, n: int):
+    """Traced per-seat fire decision for one round: attacker seat AND
+    round window AND the seeded per-round Bernoulli draw (one shared draw
+    in colluding mode). Pure function of (plan, round_idx, seats) — the
+    jitted twin of :func:`fires_this_round`."""
+    import jax
+    import jax.numpy as jnp
+
+    fire = attack_seats.astype(jnp.float32) > 0
+    if plan.rounds is not None:
+        lo, hi = plan.rounds
+        fire = fire & (round_idx >= lo) & (round_idx < hi)
+    if plan.p < 1.0:
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(plan.seed ^ 0xAD5A17), round_idx
+        )
+        if plan.collude:
+            fire = fire & (jax.random.uniform(key, ()) < plan.p)
+        else:
+            fire = fire & (jax.random.uniform(key, (n,)) < plan.p)
+    return fire
+
+
+def fires_this_round(
+    plan: AttackPlan, attack_seats: np.ndarray, round_idx: int
+) -> np.ndarray:
+    """Host-side mirror of :func:`attack_fire_mask` (identical jax.random
+    draws, forced to CPU-independent semantics by jax's deterministic PRNG)
+    — used for per-round accounting (``fedtpu_attack_injected_total``)
+    without reading anything back from the device."""
+    import jax
+    import jax.numpy as jnp
+
+    return np.asarray(
+        attack_fire_mask(
+            plan,
+            jnp.asarray(np.asarray(attack_seats, np.float32)),
+            jnp.asarray(round_idx, jnp.int32),
+            len(attack_seats),
+        )
+    )

@@ -95,6 +95,26 @@ class SimFederation(Federation):
             spec, labels, sim.population, seed=cfg.data.seed,
             batch_size=cfg.data.batch_size,
         )
+        # malicious_fraction axis (fedtpu.sim.adversary): the seeded
+        # attacker set lives at POPULATION scope — whichever cohort a
+        # malicious client lands in, it attacks there. label_flip poisons
+        # the attackers' example rows once, host-side (the population
+        # partition is a disjoint cover); delta-level kinds get their
+        # per-SEAT mask re-derived at every cohort install below.
+        self._pop_attackers = None
+        if sim.malicious_fraction > 0:
+            from fedtpu.sim import adversary
+
+            plan = adversary.parse_attack(sim.attack)
+            self._pop_attackers = adversary.attacker_mask(
+                sim.population, sim.malicious_fraction,
+                cfg.data.seed + sim.seed + plan.seed,
+            )
+            if plan.kind == "label_flip":
+                labels = adversary.flip_labels(
+                    labels, pop_idx, pop_mask, self._pop_attackers,
+                    plan.label_offset, cfg.num_classes,
+                )
         self.population = Population(
             pop_idx, pop_mask, seed=cfg.data.seed + sim.seed,
             availability=sim.availability, churn=sim.churn,
@@ -118,6 +138,7 @@ class SimFederation(Federation):
         self._slot_ids = np.where(alive0, ids0, -1)
         self._cohort_round = 0  # round the current cohort was drawn for
         self.population.mark_sampled(ids0[alive0], 0)
+        self._refresh_attack_seats(ids0, alive0)
         self._refresh_fn = None
         self._fresh_key_base = None
         self._hetero = self.population.heterogeneity_index(labels)
@@ -130,8 +151,25 @@ class SimFederation(Federation):
         idx, mask, _ = self.population.gather(ids)
         return idx, mask & alive[:, None]
 
+    def _refresh_attack_seats(self, ids: np.ndarray, alive: np.ndarray):
+        """Per-seat attacker mask for the installed cohort (delta-level
+        attack kinds only — label_flip already poisoned the data)."""
+        if (self._pop_attackers is None or self._attack_plan is None
+                or self._attack_plan.kind == "label_flip"):
+            return
+        self._attack_seats = (
+            self._pop_attackers[ids] & alive
+        ).astype(np.float32)
+
     def _set_sim_gauges(self) -> None:
         tel = self.telemetry
+        if self._pop_attackers is not None:
+            tel.gauge(
+                "fedtpu_sim_malicious_in_cohort",
+                "seeded malicious clients live in the current cohort",
+            ).set(int(
+                (self._pop_attackers[self._cohort_ids] & self.alive).sum()
+            ))
         tel.gauge(
             "fedtpu_sim_population",
             "simulated population size (host-resident clients)",
@@ -206,6 +244,7 @@ class SimFederation(Federation):
             fresh = slot_ids != self._slot_ids
             self._cohort_ids, self._cohort_round = ids, round_idx
             self.alive = alive.copy()
+            self._refresh_attack_seats(ids, alive)
             if fresh.any():
                 idx, mask = self._cohort_assignment(ids, alive)
                 _, _, w = self.population.gather(ids)

@@ -41,7 +41,9 @@ from fedtpu import models as model_zoo
 from fedtpu.config import (
     RoundConfig,
     resolve_server_pipeline,
+    screening_enabled,
     validate_retry_policy,
+    validate_screen_config,
 )
 from fedtpu.core.client import make_eval_fn, make_local_update
 from fedtpu.core import optim
@@ -140,6 +142,11 @@ class LocalTrainer:
         # the next round's delta (the host-side analogue of
         # fedtpu.ops.compression residuals).
         self.edge_residual = None
+        # Byzantine self: an armed FaultSchedule whose ATTACK_KINDS rules
+        # make THIS client adversarial (fedtpu.ft.chaos.decide_attack —
+        # keyed on `identity`, the client's serving address). None = honest.
+        self.chaos = None
+        self.identity = "self"
         # Dense f32 wire size of one full model payload — the denominator
         # of the compression-ratio gauge (codec bytes / dense bytes).
         self._dense_bytes = sum(
@@ -196,6 +203,18 @@ class LocalTrainer:
 
     def _train_round_impl(self, rank: int, world: int) -> bytes:
         cfg = self.cfg
+        # Model-level attack consult (fedtpu.ft.chaos ATTACK_KINDS): one
+        # decision per training round, keyed on this client's identity and
+        # local round. label_flip poisons THIS round's training labels;
+        # delta kinds poison only the SUBMITTED payload below — the
+        # attacker's own local state stays its honest trajectory, exactly
+        # like a real adversary running an unmodified trainer with a
+        # poisoned send hook.
+        atk_round = self.round_idx
+        atk = (
+            self.chaos.decide_attack(self.identity, atk_round)
+            if self.chaos is not None else None
+        )
         own, own_mask = self._shard(rank, world)
         num_examples = float(own_mask.sum())
         # One epoch = the shard's batch count; local_epochs multiplies it
@@ -212,6 +231,8 @@ class LocalTrainer:
             steps,
             seed=cfg.data.seed + self.round_idx,
         )
+        if atk is not None and atk.kind == "label_flip":
+            y = (np.asarray(y) + atk.label_offset) % cfg.num_classes
         self.rng, step_rng = jax.random.split(self.rng)
         start_params, start_stats = self.params, self.batch_stats
         out = self._local_update(
@@ -228,6 +249,22 @@ class LocalTrainer:
         self.batch_stats = out.batch_stats
         self.opt_state = out.opt_state
         self.round_idx += 1
+        send_params, send_stats = out.params, out.batch_stats
+        if atk is not None and atk.kind in ("sign_flip", "scale", "noise"):
+            honest = jax.tree.map(
+                lambda a, b: np.asarray(a) - np.asarray(b),
+                {"params": out.params, "batch_stats": out.batch_stats},
+                {"params": start_params, "batch_stats": start_stats},
+            )
+            hostile = self.chaos.apply_attack_delta(
+                atk, honest, self.identity, atk_round
+            )
+            sent = jax.tree.map(
+                lambda s, d: (np.asarray(s) + d).astype(np.asarray(s).dtype),
+                {"params": start_params, "batch_stats": start_stats},
+                hostile,
+            )
+            send_params, send_stats = sent["params"], sent["batch_stats"]
 
         codec = cfg.fed.compression
         if codec in ("topk", "int8") and self.synced:
@@ -235,7 +272,7 @@ class LocalTrainer:
             # unlike the reference's gzip-over-dense (src/server.py:104-107).
             delta = jax.tree.map(
                 lambda a, b: np.asarray(a) - np.asarray(b),
-                {"params": out.params, "batch_stats": out.batch_stats},
+                {"params": send_params, "batch_stats": send_stats},
                 {"params": start_params, "batch_stats": start_stats},
             )
             extra = {"num_examples": np.float32(num_examples)}
@@ -263,8 +300,8 @@ class LocalTrainer:
             return payload
 
         payload = {
-            "params": out.params,
-            "batch_stats": out.batch_stats,
+            "params": send_params,
+            "batch_stats": send_stats,
             "num_examples": np.float32(num_examples),
         }
         return wire.encode(payload, compress=codec != "none")
@@ -350,9 +387,14 @@ def serve_client(
     agent = ClientAgent(cfg, seed=seed)
     # The bind address doubles as the client's trace/flight identity.
     agent.trainer.telemetry.role = f"client:{address}"
+    agent.trainer.identity = address
     if chaos is not None:
         chaos.attach(metrics=agent.trainer.telemetry.registry
                      if agent.trainer.telemetry.enabled else None)
+        # ATTACK_KINDS rules in the schedule make this client Byzantine:
+        # the trainer consults them per round (decide_attack) and poisons
+        # its submissions/labels accordingly.
+        agent.trainer.chaos = chaos
     server = create_server(address, agent, compress=compress, chaos=chaos)
     server.start()
     return server, agent
@@ -462,6 +504,14 @@ class PrimaryServer:
                 f"unknown aggregator {cfg.fed.aggregator!r}; "
                 "have mean | median | trimmed_mean | krum"
             )
+        # Robust aggregators silently ignore example-count weights; say it
+        # once at startup and stamp every round record (satellite of the
+        # Byzantine PR — the silence read as a bug to operators).
+        self._weights_ignored = False
+        if cfg.fed.weighted:
+            from fedtpu.core.round import warn_weighted_robust
+
+            self._weights_ignored = warn_weighted_robust(cfg.fed.aggregator)
         if cfg.fed.aggregator != "mean":
             if cfg.fed.compression != "none":
                 raise ValueError(
@@ -576,6 +626,28 @@ class PrimaryServer:
                 donate_argnums=0,
             )
             self._finalize_stream = jax.jit(self._finalize_stream_impl)
+        # Fused update screening (ScreenConfig, docs/FAULT_TOLERANCE.md):
+        # one jitted stats pass over the round's [participants, P] rows —
+        # the SAME device-resident buffer the stream finalize reads, so the
+        # collect path gains zero extra device syncs — whose verdicts (a)
+        # drop rejected rows from the combine through the existing
+        # exclusion-by-order mask and (b) feed the per-client suspicion
+        # EWMA driving quarantine -> eviction on the MembershipTable.
+        self._screen_jit = None
+        if screening_enabled(cfg.fed.screen):
+            from fedtpu.ops import flat as flat_ops
+
+            sc = validate_screen_config(cfg.fed.screen)
+            self._screen_cfg = sc
+            params_t, stats_t = _model_template(self.model, cfg)
+            self._screen_layout = flat_ops.make_layout(
+                {"params": params_t, "batch_stats": stats_t}
+            )
+            self._screen_jit = jax.jit(
+                lambda rows, live: flat_ops.screen_rows(
+                    rows, live, sc.norm_max, sc.zmax, sc.cos_min
+                )
+            )
         self.history: List[dict] = []
         self._did_initial_sync = False
         # Straggler StartTrain threads still in flight from earlier rounds,
@@ -992,6 +1064,47 @@ class PrimaryServer:
             )
         return {"left": left, "version": self.registry.version}
 
+    def _update_reputation(
+        self, order: List[str], flagged: set, quarantined_now: set
+    ) -> None:
+        """Close the detection -> eviction loop: fold this round's
+        screening verdicts into each participant's suspicion EWMA and run
+        the escalation ladder (flagged -> quarantined -> evicted) against
+        the live :class:`~fedtpu.ft.membership.MembershipTable`.
+
+        - suspicion >= ``quarantine_at``: quarantine (the member is still
+          served and screened — it can redeem itself — but its updates are
+          ignored; counted into ``fedtpu_membership_quarantine_total``).
+        - a quarantined member whose suspicion decays below ``release_at``
+          is released (the false-positive exit).
+        - ``evict_after`` consecutive quarantined rounds escalates to
+          :meth:`remove_client` with reason ``quarantine`` — the roster
+          change replicates to the backup like any other eviction.
+        """
+        sc = self._screen_cfg
+        for c in order:
+            s = self.registry.observe_screening(c, c in flagged, ewma=sc.ewma)
+            if c in quarantined_now:
+                rounds_q = self.registry.tick_quarantine(c)
+                if s < sc.release_at:
+                    if self.registry.release(c):
+                        self.flight.record(
+                            "membership", event="release", client=c,
+                            suspicion=round(s, 4),
+                        )
+                elif sc.evict_after and rounds_q >= sc.evict_after:
+                    log.warning(
+                        "client %s evicted after %d quarantined rounds "
+                        "(suspicion %.3f)", c, rounds_q, s,
+                    )
+                    self.remove_client(c, reason="quarantine")
+            elif s >= sc.quarantine_at:
+                if self.registry.quarantine(c):
+                    self.flight.record(
+                        "membership", event="quarantine", client=c,
+                        suspicion=round(s, 4),
+                    )
+
     def _membership_bytes(self) -> np.ndarray:
         """The roster snapshot as a uint8 JSON leaf for the replica/
         checkpoint pytree (flax msgpack carries variable-length arrays)."""
@@ -1178,8 +1291,12 @@ class PrimaryServer:
             self.sync_clients()
         # Roster snapshot for this round: cohort selection runs over the
         # LIVE set of the CURRENT membership; a join/leave landing mid-round
-        # takes effect next round.
+        # takes effect next round. Quarantined members stay in the launch —
+        # they are SERVED (broadcasts, StartTrain) and keep generating
+        # screening evidence so they can redeem themselves — but their
+        # updates are dropped before the combine, whatever arrives.
         active = self.registry.active_clients()
+        quarantined_now = set(self.registry.quarantined_clients())
         members_now = self.registry.size
         membership_version = self.registry.version
         # The round record's alive mask spans THIS snapshot's roster — a
@@ -1545,9 +1662,81 @@ class PrimaryServer:
             return rec
 
         self.status.update(phase="aggregate")
-        if completed:
-            with tel.span("aggregate", participants=len(completed)):
-                order = [c for c in active if c in completed]
+        order = [c for c in active if c in completed]
+        srows = None
+        if stream and dev_buf:
+            # Close the round's buffer under the lock first: a deadline
+            # straggler must not donate-invalidate the handle we are about
+            # to read. When a launched client failed or straggled, gather
+            # the surviving rows so the reduce runs over EXACTLY the rows
+            # the barrier path would stack (same [k, P] shape -> the same
+            # order-stable reduce -> bit parity).
+            with stream_lock:
+                srows = dev_buf.pop()
+            if order != launch:
+                srows = srows[
+                    jnp.asarray([row_of[c] for c in order], jnp.int32)
+                ]
+        # ---- fused screening + reputation (docs/FAULT_TOLERANCE.md) ----
+        screened_names: List[str] = []
+        if self._screen_jit is not None and order:
+            with tel.span("screen", participants=len(order)):
+                if stream:
+                    rows_in = srows  # already device-resident, zero syncs
+                else:
+                    from fedtpu.ops import flat as flat_ops
+
+                    host = np.zeros(
+                        (len(order), self._screen_layout.padded), np.float32
+                    )
+                    for i, c in enumerate(order):
+                        flat_ops.pack_row_host(
+                            self._screen_layout, completed[c][0], out=host[i]
+                        )
+                    rows_in = jnp.asarray(host)
+                # Quarantined rows must not pollute the reference stats
+                # (median direction, median/MAD) but still get verdicts.
+                live = jnp.asarray(
+                    [c not in quarantined_now for c in order], jnp.float32
+                )
+                keep, _sstats = self._screen_jit(rows_in, live)
+                keep = np.asarray(keep)
+            screened_names = [
+                c for i, c in enumerate(order) if not bool(keep[i])
+            ]
+            self._update_reputation(
+                order, set(screened_names), quarantined_now
+            )
+            if screened_names:
+                log.warning(
+                    "round %d: screening rejected %s",
+                    self._round_counter, screened_names,
+                )
+                tel.counter(
+                    "fedtpu_screening_rejected_total",
+                    "client rows rejected by the fused screening stage, "
+                    "by surface",
+                    labels={"surface": "server"},
+                ).inc(len(screened_names))
+        # Drop screened rows AND anything a quarantined client delivered —
+        # a quarantined (or just-screened) late reply is log-and-ignored
+        # exactly like an evicted id's, never aggregated.
+        dropped = set(screened_names) | (quarantined_now & set(completed))
+        if quarantined_now & set(completed):
+            log.info(
+                "round %d: ignoring quarantined updates from %s",
+                self._round_counter, sorted(quarantined_now & set(completed)),
+            )
+        if dropped:
+            keep_idx = [
+                i for i, c in enumerate(order) if c not in dropped
+            ]
+            if stream and srows is not None and len(keep_idx) != len(order):
+                srows = srows[jnp.asarray(keep_idx, jnp.int32)]
+            order = [c for c in order if c not in dropped]
+
+        if order:
+            with tel.span("aggregate", participants=len(order)):
                 if cfg.fed.weighted:
                     weights = jnp.asarray(
                         [completed[c][1] for c in order], jnp.float32
@@ -1557,21 +1746,8 @@ class PrimaryServer:
                 if stream:
                     # The rows are already device-resident (shipped on
                     # arrival) — the only post-barrier work is ONE fused
-                    # finalize. Close the round's buffer under the lock
-                    # first: a deadline straggler must not donate-invalidate
-                    # the handle we are about to read. When a launched
-                    # client failed or straggled, gather the surviving rows
-                    # so the reduce runs over EXACTLY the rows the barrier
-                    # path would stack (same [k, P] shape -> the same
-                    # order-stable reduce -> bit parity).
-                    with stream_lock:
-                        rows = dev_buf.pop()
-                    if order != launch:
-                        rows = rows[
-                            jnp.asarray(
-                                [row_of[c] for c in order], jnp.int32
-                            )
-                        ]
+                    # finalize over the surviving rows.
+                    rows = srows
                     new_global, self._server_opt_state = (
                         self._finalize_stream(
                             {"params": self.params,
@@ -1705,6 +1881,9 @@ class PrimaryServer:
             "participants": len(completed),
             "stragglers": len(stragglers),
             "world": world,
+            # Rows that actually entered the combine (participants minus
+            # screening rejections and ignored quarantined deliveries).
+            "aggregated": len(order),
             "alive": [self.registry.is_alive(c) for c in roster_now],
             "membership_version": membership_version,
             # Flat-buffer footprint of this round's streaming collect (host
@@ -1730,6 +1909,15 @@ class PrimaryServer:
             "t_aggregate_s": round(t_done - t_barrier, 6),
             "t_post_barrier_s": round(t_done - t_barrier, 6),
         }
+        if self._weights_ignored:
+            # Operator-facing flag (satellite): the robust aggregator ran
+            # UNWEIGHTED even though weighted=True — by design, not a bug.
+            rec["weights_ignored"] = True
+        if self._screen_jit is not None:
+            rec["screened"] = screened_names
+            rec["quarantined"] = sorted(
+                self.registry.quarantined_clients()
+            )
         self.history.append(rec)
         return rec
 
@@ -1789,6 +1977,13 @@ class PrimaryServer:
             raise ValueError(
                 "run_async does not support DP: per-update participation "
                 "accounting differs from the synchronous analysis."
+            )
+        if self._screen_jit is not None:
+            raise ValueError(
+                "run_async does not support update screening: the "
+                f"buffer of {buffer_k} is too small a population for the "
+                "median/MAD reference statistics. Use the synchronous "
+                "round loop."
             )
         if buffer_k < 1:
             raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")

@@ -298,6 +298,75 @@ def test_chaos_overhead_microbench_contract(bench, monkeypatch, tmp_path):
         assert json_mod.load(f) == result
 
 
+def test_screening_overhead_microbench_contract(bench, monkeypatch, tmp_path):
+    """--screening-overhead-microbench at a seconds-scale config: schema +
+    artifact emission (the <=1%-on-densenet acceptance gate itself is
+    pinned by the committed artifacts/SCREENING_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_SC_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_SC_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_SC_REPS", "2")
+    result = bench._screening_overhead_microbench()
+    assert result["metric"] == "screening_overhead"
+    assert result["value"] > 0
+    assert result["per_round_screen_us"] > 0
+    assert result["padded_row"] % 128 == 0
+    # The attributable arithmetic is auditable from its own parts.
+    assert result["value"] == pytest.approx(
+        result["per_round_screen_us"]
+        / (result["round_ms"]["bare"] * 1e3) * 100.0,
+        rel=1e-2,
+    )
+    assert result["gate_pct"] == 1.0
+    assert isinstance(result["passes_gate"], bool)
+    assert result["noise_floor_pct"] >= 0
+    assert set(result["round_ms"]) == {"bare", "screen"}
+    assert all(v > 0 for v in result["round_ms"].values())
+    path = os.path.join(str(art), "SCREENING_MICROBENCH.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
+def test_byzantine_soak_artifact_contract():
+    """Schema + gate contract of the committed 100-round Byzantine soak
+    (tools/chaos_soak.py --byzantine): the attack-harness PR's acceptance
+    evidence. The soak re-runs as `slow` (tests/test_byzantine.py); this
+    pins what it must have proven."""
+    result = _committed_artifact("BYZANTINE_SOAK.json")
+    assert result["ok"] is True
+    cfg = result["config"]
+    assert cfg["rounds"] >= 100
+    assert cfg["malicious"] >= round(0.28 * cfg["clients"])  # ~30% regime
+    assert cfg["error_p"] >= 0.10                            # + wire faults
+    # Monotone lineage, no lost rounds.
+    lineage = result["lineage"]
+    assert lineage["committed"] == cfg["rounds"]
+    assert lineage["exact_cover"]
+    obs = result["observed"]
+    # Zero honest deaths; every attacker quarantined AND evicted through
+    # the live membership machinery; no honest eviction, no honest client
+    # left quarantined.
+    assert obs["client_deaths"] == 0
+    assert obs["quarantines"] >= cfg["malicious"]
+    assert obs["evictions_quarantine"] == cfg["malicious"]
+    assert result["attackers_still_members"] == []
+    assert result["honest_evicted"] == []
+    assert result["honest_quarantined_at_end"] == []
+    # Every layer demonstrably fired: attacks, screening, wire chaos,
+    # retries.
+    assert obs["attack_injected"] > 0
+    assert obs["screening_rejected"] >= cfg["malicious"]
+    assert obs["chaos_injected"] > 0 and obs["rpc_retries"] > 0
+    # Honest clients finished with finite evals.
+    assert len(result["honest_final_evals"]) == cfg["clients"] - cfg["malicious"]
+    for e in result["honest_final_evals"]:
+        assert e["loss"] == e["loss"]
+
+
 def test_cohort_scale_contract(bench, monkeypatch, tmp_path):
     """--cohort-scale at a seconds-scale config: schema + artifact emission
     and the two claims the acceptance criterion leans on — per-seat device

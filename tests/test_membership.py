@@ -118,7 +118,7 @@ def test_concurrent_admit_evict_revive_races():
     while time.monotonic() < stop:
         versions.append(t.version)
         snap = t.snapshot()
-        seats = [s for _, s, _ in snap["members"]]
+        seats = [row[1] for row in snap["members"]]
         assert len(set(seats)) == len(seats), "duplicate seats"
         assert max(seats, default=-1) < snap["capacity"]
     for th in threads:

@@ -163,6 +163,81 @@ def test_trimmed_mean_stays_within_live_range(n, cols, trim, seed):
 
 @_slow
 @given(
+    n=st.integers(3, 10),
+    cols=st.integers(2, 64),
+    seed=st.integers(0, 10),
+    zmax=st.floats(0.5, 8.0),
+    cos_min=st.floats(-1.0, 0.9),
+)
+def test_screening_stats_are_permutation_equivariant(
+    n, cols, seed, zmax, cos_min
+):
+    """Reordering the client rows reorders verdicts and stats identically
+    (the reference statistics — median direction, median/MAD — are
+    order-free reductions), so screening can never depend on arrival
+    order: the stream and barrier server pipelines, which see rows in
+    different orders, must produce the same per-client verdicts."""
+    from fedtpu.ops.flat import screen_rows
+
+    rng = np.random.default_rng(seed)
+    rows = rng.normal(size=(n, cols)).astype(np.float32)
+    alive = (rng.uniform(size=n) > 0.2).astype(np.float32)
+    perm = rng.permutation(n)
+    keep, stats = screen_rows(
+        jnp.asarray(rows), jnp.asarray(alive), 0.0, zmax, cos_min
+    )
+    keep_p, stats_p = screen_rows(
+        jnp.asarray(rows[perm]), jnp.asarray(alive[perm]), 0.0, zmax,
+        cos_min,
+    )
+    np.testing.assert_array_equal(np.asarray(keep)[perm], np.asarray(keep_p))
+    for key in ("norm", "cos", "z"):
+        np.testing.assert_allclose(
+            np.asarray(stats[key])[perm], np.asarray(stats_p[key]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+@_slow
+@given(
+    n=st.integers(3, 10),
+    cols=st.integers(2, 64),
+    seed=st.integers(0, 10),
+    scale=st.floats(1e-3, 1e3),
+)
+def test_screening_relative_stats_are_scale_invariant(n, cols, seed, scale):
+    """Scaling EVERY row by a common positive factor scales the norms
+    linearly (equivariance) but leaves cosine and the median/MAD z-score
+    unchanged — the relative checks need no per-model calibration, which
+    is what lets one zmax/cos_min config cover mlp and densenet alike."""
+    from fedtpu.ops.flat import screen_rows
+
+    rng = np.random.default_rng(seed)
+    rows = rng.normal(size=(n, cols)).astype(np.float32)
+    alive = np.ones((n,), np.float32)
+    keep_a, stats_a = screen_rows(
+        jnp.asarray(rows), jnp.asarray(alive), 0.0, 3.0, 0.0
+    )
+    keep_b, stats_b = screen_rows(
+        jnp.asarray(rows * scale), jnp.asarray(alive), 0.0, 3.0, 0.0
+    )
+    np.testing.assert_array_equal(np.asarray(keep_a), np.asarray(keep_b))
+    np.testing.assert_allclose(
+        np.asarray(stats_b["norm"]), np.asarray(stats_a["norm"]) * scale,
+        rtol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats_b["cos"]), np.asarray(stats_a["cos"]),
+        rtol=2e-3, atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats_b["z"]), np.asarray(stats_a["z"]),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+@_slow
+@given(
     n=st.integers(2, 12),
     k=st.integers(1, 4),
     power=st.floats(0.1, 3.0),

@@ -288,6 +288,102 @@ def test_krum_distributed_edge():
         assert move < 0.02, move
 
 
+def test_trimmed_mean_trim_zero_is_bit_identical_to_mean():
+    """trim_fraction=0 trims nothing, so it must equal the uniform mean
+    BIT-FOR-BIT (same ops, not just same math) — engine combiner level and
+    full round level, with dead-client masking."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(6, 9, 4)).astype(np.float32)
+    w = np.asarray([1.0, 1.0, 0.0, 1.0, 1.0, 1.0], np.float32)
+    from fedtpu.core.round import _mean_over_clients
+
+    robust = _robust_over_clients(
+        {"a": jnp.asarray(x)}, jnp.asarray(w), None, "trimmed_mean", 0.0
+    )["a"]
+    mean = _mean_over_clients(
+        {"a": jnp.asarray(x)}, jnp.asarray(w), None
+    )[0]["a"]
+    np.testing.assert_array_equal(np.asarray(robust), np.asarray(mean))
+
+    # Full engine round: weighted=False mean vs trimmed_mean trim=0.
+    params = {}
+    for aggregator in ("mean", "trimmed_mean"):
+        cfg = _cfg(aggregator=aggregator, trim_fraction=0.0, weighted=False)
+        fed = Federation(cfg, seed=0)
+        fed.step()
+        params[aggregator] = jax.tree_util.tree_leaves(fed.state.params)
+    for a, b in zip(params["mean"], params["trimmed_mean"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trim_zero_bit_identical_on_distributed_edge():
+    """Same pin for PrimaryServer._aggregate (the barrier combine)."""
+    from fedtpu.transport.federation import PrimaryServer
+
+    outs = {}
+    for aggregator in ("mean", "trimmed_mean"):
+        srv = PrimaryServer(
+            _cfg(aggregator=aggregator, trim_fraction=0.0, weighted=False),
+            clients=[], seed=0,
+        )
+        rng = np.random.default_rng(0)
+        deltas = jax.tree.map(
+            lambda p: jnp.asarray(
+                rng.normal(size=(3,) + np.shape(p)).astype(np.float32)
+            ),
+            {"params": srv.params, "batch_stats": srv.batch_stats},
+        )
+        g = {"params": srv.params, "batch_stats": srv.batch_stats}
+        out, _ = srv._aggregate(
+            g, deltas, jnp.ones((3,)), srv._server_opt_state,
+            jnp.asarray(0, jnp.int32),
+        )
+        outs[aggregator] = jax.tree_util.tree_leaves(out)
+    for a, b in zip(outs["mean"], outs["trimmed_mean"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_weighted_robust_warns_once_and_flags_round_record():
+    """weighted=True + a robust aggregator silently ignores example-count
+    weights; that must warn (once) and stamp the round record."""
+    from fedtpu.core import round as round_lib
+    from fedtpu.transport.federation import PrimaryServer
+
+    round_lib._WEIGHTED_ROBUST_WARNED.discard("median")
+    with _capture_warnings() as records:
+        Federation(_cfg(aggregator="median", weighted=True), seed=0)
+        Federation(_cfg(aggregator="median", weighted=True), seed=0)
+    assert sum("ignores example-count weights" in r for r in records) == 1
+    # The distributed server stamps every committed round record.
+    srv = PrimaryServer(
+        _cfg(aggregator="median", weighted=True), clients=[], seed=0
+    )
+    assert srv._weights_ignored is True
+    plain = PrimaryServer(
+        _cfg(aggregator="mean", weighted=True), clients=[], seed=0
+    )
+    assert plain._weights_ignored is False
+
+
+class _capture_warnings:
+    """Capture fedtpu.round warning messages."""
+
+    def __enter__(self):
+        import logging
+
+        self.records = []
+        self.handler = logging.Handler()
+        self.handler.emit = lambda rec: self.records.append(rec.getMessage())
+        logging.getLogger("fedtpu.round").addHandler(self.handler)
+        return self.records
+
+    def __exit__(self, *exc):
+        import logging
+
+        logging.getLogger("fedtpu.round").removeHandler(self.handler)
+        return False
+
+
 def test_trimmed_mean_never_empties_the_band_at_small_n():
     """Interpolated quantile bounds can exclude BOTH values at n=2 (verified
     failure mode); data-point bounds must keep the band non-empty."""

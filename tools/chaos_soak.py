@@ -401,6 +401,211 @@ def run_soak(
             s.stop(0)
 
 
+# ------------------------------------------------------------ byzantine soak
+def run_byzantine_soak(
+    rounds: int = 100,
+    clients: int = 7,
+    malicious: int = 2,
+    error_p: float = 0.10,
+    retries: int = 6,
+    quorum: float = 0.25,
+    evict_after: int = 5,
+    seed: int = 7,
+    verbose: bool = True,
+) -> dict:
+    """The Byzantine soak (acceptance spine of the attack-harness PR):
+    ``rounds`` federated rounds over the LIVE gRPC transport with ~30%
+    seeded model-level attackers (sign_flip + boosted-scale, armed through
+    the chaos DSL on the attacker agents) AND ~10% transient StartTrain
+    faults (the PR 5 wire-chaos layer, primary-side), with fused screening
+    + reputation + quarantine escalation armed. Gates:
+
+    1. **zero honest-client deaths** — the transient faults retry away and
+       the defense never kills an honest client
+       (``fedtpu_ft_client_deaths_total == 0``);
+    2. **every attacker quarantined-then-evicted** through the live
+       MembershipTable (``fedtpu_membership_quarantine_total == malicious``,
+       evictions ``reason=quarantine`` == malicious, attackers absent from
+       the final roster);
+    3. **monotone lineage** — committed round records cover exactly
+       ``0..rounds-1``;
+    4. the attack/chaos/screening layers all demonstrably fired.
+
+    Writes ``artifacts/BYZANTINE_SOAK.json`` via ``--byzantine``.
+    """
+    from fedtpu.config import RetryPolicy, ScreenConfig
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import parse_prometheus_text, prometheus_text
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    t_start = time.monotonic()
+
+    def note(msg):
+        if verbose:
+            print(f"[byz] {msg}", flush=True)
+
+    assert 0 < malicious < clients
+    cfg = _tiny_cfg(
+        clients, rounds,
+        weighted=False,
+        round_quorum=quorum,
+        # quarantine_at 0.8 with ewma 0.5 = three CONSECUTIVE flags to
+        # quarantine: a persistent attacker escalates by round 3 while a
+        # one-off honest false positive decays back to zero. Calibration
+        # (measured on this workload, instrumented 40-round run): once
+        # training converges the honest norm SPREAD reaches ~4x the median
+        # (tiny noise-dominated gradients) while the boosted attacker sits
+        # at 25x; under screen_rows' MAD floor z ~= 13.5*(norm/median - 1),
+        # so zmax=60 cuts at ~5x the median — between the two populations,
+        # with the cushion on the honest side (zmax=6 flagged honest
+        # heterogeneity, and exclusion is self-reinforcing: a wrongly
+        # screened client's data leaves the aggregate, inflating its next
+        # delta). cos_min=-0.5 not 0 for the same reason: converged honest
+        # cosines hover around 0; only a strong contrarian (sign-flip
+        # scores ~-1) is evidence.
+        screen=ScreenConfig(
+            zmax=60.0, cos_min=-0.5, ewma=0.5,
+            quarantine_at=0.8, release_at=0.2, evict_after=evict_after,
+        ),
+        retry=RetryPolicy(max_attempts=retries, backoff_s=0.01),
+    )
+    # Attacker i alternates the two delta-level kinds; every attacker fires
+    # every round (persistent adversaries — the quarantine ladder's case).
+    attack_specs = [
+        f"sign_flip:p=1.0,seed={seed + i}" if i % 2 == 0
+        else f"scale:factor=25,p=1.0,seed={seed + i}"
+        for i in range(malicious)
+    ]
+    wire_spec = f"error@StartTrain:p={error_p},consec=2,seed={seed}"
+    assert retries > 3, "retry budget must exceed the consec cap"
+
+    servers, addrs, agents = [], [], []
+    primary = None
+    result: dict = {"config": {
+        "rounds": rounds, "clients": clients, "malicious": malicious,
+        "error_p": error_p, "retries": retries, "quorum": quorum,
+        "evict_after": evict_after, "seed": seed,
+        "attack_specs": attack_specs, "wire_spec": wire_spec,
+    }}
+    try:
+        for i in range(clients):
+            addr = f"localhost:{free_port()}"
+            chaos = parse_spec(attack_specs[i]) if i < malicious else None
+            srv, agent = serve_client(addr, cfg, seed=i, chaos=chaos)
+            servers.append(srv)
+            addrs.append(addr)
+            agents.append(agent)
+        attackers = set(addrs[:malicious])
+        note(f"{clients} clients up, attackers: {sorted(attackers)}")
+        primary = PrimaryServer(cfg, addrs, chaos=parse_spec(wire_spec))
+        records = []
+        primary.run(num_rounds=rounds,
+                    on_round=lambda r, rec: records.append(rec))
+
+        committed = [r for r in records if not r.get("aborted")]
+        lineage = [int(r["round"]) for r in committed]
+        parsed = parse_prometheus_text(
+            prometheus_text(primary.telemetry.registry)
+        )
+
+        def metric_sum(name, label_filter=None):
+            total = 0.0
+            for labels, v in parsed.get(name, {}).items():
+                if label_filter is None or label_filter in labels:
+                    total += v
+            return total
+
+        attack_injected = sum(
+            sum(parse_prometheus_text(
+                prometheus_text(a.trainer.telemetry.registry)
+            ).get("fedtpu_attack_injected_total", {}).values())
+            for a in agents
+        )
+        result["lineage"] = {
+            "committed": len(committed),
+            "aborted": len(records) - len(committed),
+            "exact_cover": lineage == list(range(rounds)),
+        }
+        result["observed"] = {
+            "client_deaths": metric_sum("fedtpu_ft_client_deaths_total"),
+            "rpc_retries": metric_sum("fedtpu_rpc_retries_total"),
+            "chaos_injected": metric_sum("fedtpu_chaos_injected_total"),
+            "attack_injected": attack_injected,
+            "screening_rejected": metric_sum(
+                "fedtpu_screening_rejected_total"),
+            "quarantines": metric_sum("fedtpu_membership_quarantine_total"),
+            "evictions_quarantine": metric_sum(
+                "fedtpu_membership_evictions_total", "quarantine"),
+        }
+        result["final_roster"] = primary.registry.status()
+        result["attackers_still_members"] = sorted(
+            a for a in attackers if primary.registry.is_member(a)
+        )
+        honest = [a for a in addrs if a not in attackers]
+        result["honest_evicted"] = sorted(
+            a for a in honest if not primary.registry.is_member(a)
+        )
+        result["honest_quarantined_at_end"] = sorted(
+            a for a in honest
+            if primary.registry.is_quarantined(a)
+        )
+
+        # ------------------------------------------------------- the gates
+        obs = result["observed"]
+        assert result["lineage"]["exact_cover"], (
+            f"lineage not exactly 0..{rounds - 1}: {result['lineage']}"
+        )
+        assert obs["client_deaths"] == 0, (
+            f"{obs['client_deaths']} client deaths — transient faults or "
+            "the defense killed an honest client"
+        )
+        assert not result["attackers_still_members"], (
+            "attackers survived in the roster: "
+            f"{result['attackers_still_members']}"
+        )
+        # Honest clients may suffer a TRANSIENT false-positive quarantine
+        # over a long soak (the redemption path exists for exactly that),
+        # but must never be evicted and must end the soak unquarantined.
+        assert not result["honest_evicted"], (
+            f"honest clients evicted: {result['honest_evicted']}"
+        )
+        assert not result["honest_quarantined_at_end"], (
+            "honest clients still quarantined at soak end: "
+            f"{result['honest_quarantined_at_end']}"
+        )
+        assert obs["quarantines"] >= malicious, (
+            f"{obs['quarantines']} quarantines, wanted >= {malicious}"
+        )
+        assert obs["evictions_quarantine"] == malicious, (
+            f"{obs['evictions_quarantine']} quarantine evictions, wanted "
+            f"{malicious}"
+        )
+        assert obs["attack_injected"] > 0, "no attack ever executed"
+        assert obs["screening_rejected"] >= malicious, (
+            "screening never rejected the attackers"
+        )
+        assert obs["chaos_injected"] > 0 and obs["rpc_retries"] > 0, (
+            "the transient-fault layer never exercised the retry path"
+        )
+        # Honest clients finished with finite evals (they kept being
+        # served throughout the attack).
+        evals = []
+        for addr, agent in zip(addrs, agents):
+            if addr in attackers:
+                continue
+            assert agent.last_eval is not None, f"{addr} never evaluated"
+            loss, acc = agent.last_eval
+            assert loss == loss and abs(loss) != float("inf"), loss
+            evals.append({"loss": loss, "acc": acc})
+        result["honest_final_evals"] = evals
+        result["wall_s"] = round(time.monotonic() - t_start, 2)
+        result["ok"] = True
+        return result
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
 # ---------------------------------------------------------------- churn soak
 class GhostableAgent:
     """A ClientAgent whose reachability is a driver-controlled switch:
@@ -941,6 +1146,18 @@ def main(argv=None) -> int:
                     "chaos run (2*3+1 attempts under the default spec)")
     ap.add_argument("--workdir", default="/tmp/fedtpu_chaos_soak")
     ap.add_argument(
+        "--byzantine", action="store_true",
+        help="run the Byzantine soak instead: N rounds over real gRPC "
+        "with ~30%% seeded model-level attackers + ~10%% transient wire "
+        "faults, screening/quarantine armed; gates zero honest deaths, "
+        "every attacker quarantined-and-evicted, monotone lineage; "
+        "writes artifacts/BYZANTINE_SOAK.json",
+    )
+    ap.add_argument("--byz-rounds", default=100, type=int)
+    ap.add_argument("--byz-clients", default=7, type=int)
+    ap.add_argument("--byz-malicious", default=2, type=int)
+    ap.add_argument("--byz-error-p", default=0.10, type=float)
+    ap.add_argument(
         "--churn", action="store_true",
         help="run the long-haul elastic-membership churn soak instead "
         "(continuous join/leave/rejoin + one mid-soak rolling upgrade; "
@@ -955,6 +1172,25 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.byzantine:
+        try:
+            result = run_byzantine_soak(
+                rounds=args.byz_rounds,
+                clients=args.byz_clients,
+                malicious=args.byz_malicious,
+                error_p=args.byz_error_p,
+                retries=max(args.retries, 4),
+                seed=args.seed,
+            )
+        except AssertionError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}))
+            return 1
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "BYZANTINE_SOAK.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        print(json.dumps(result))
+        return 0
     if args.churn:
         try:
             result = run_churn_soak(

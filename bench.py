@@ -1053,6 +1053,156 @@ def _chaos_overhead_microbench():
     return result
 
 
+def _checkpoint_overhead_microbench():
+    """``checkpoint_overhead``: what per-round durable checkpointing costs
+    the ROUND LOOP under the background writer
+    (:class:`fedtpu.checkpoint.BackgroundCheckpointer`). The loop-side
+    work is only the device->host state snapshot + queue handoff; the
+    encode + fsync'd atomic write + manifest + verify + prune run on the
+    writer thread, overlapped with the next round's compute. Acceptance
+    gate of the durability PR: the loop-side cost must be <= 1% of a
+    densenet_cifar CPU round at checkpoint-every-round cadence.
+
+    Same two-measurement methodology as ``--chaos-overhead-microbench``:
+
+    - **Attributable cost** (the headline ``value``): the exact
+      ``save()`` call the round loop makes, timed directly with the
+      writer idle before each call (flush between timed saves, flush time
+      excluded) and scaled by the bare round wall. The synchronous path's
+      full inline save (``sync_full``) and the writer-side write wall
+      (``writer_write``, from ``fedtpu_checkpoint_write_seconds``) ride
+      along, so the artifact shows exactly what the background split
+      buys.
+    - **A/B walls (audit)**: the same compiled engine driven with and
+      without a per-round background save (final flush inside the timed
+      block — an upper bound on steady-state), mode order rotated per
+      rep, medians next to the bare trials' spread (``noise_floor_pct``).
+
+    Run via ``python bench.py --checkpoint-overhead-microbench``; prints
+    one JSON line and writes ``artifacts/CHECKPOINT_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from fedtpu.checkpoint import BackgroundCheckpointer, Checkpointer
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.obs import MetricsRegistry
+
+    model_name = os.environ.get("FEDTPU_CK_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_CK_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_CK_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_CK_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_CK_BATCH", "8"))
+    timed_saves = int(os.environ.get("FEDTPU_CK_SAVES", "10"))
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=10,
+        data=DataConfig(
+            dataset="cifar10", batch_size=batch, partition="iid",
+            num_examples=clients * batch * 4,
+        ),
+        fed=FedConfig(num_clients=clients, telemetry="off"),
+        steps_per_round=1,
+    )
+    fed = Federation(cfg, seed=0)
+    workdir = tempfile.mkdtemp(prefix="fedtpu_ckpt_mb_")
+    reg = MetricsRegistry()
+    inner = Checkpointer(
+        os.path.join(workdir, "async"), keep=3, backend="wire", metrics=reg,
+    )
+    bg = BackgroundCheckpointer(inner)
+    sync_ckpt = Checkpointer(
+        os.path.join(workdir, "sync"), keep=3, backend="wire",
+    )
+
+    def run_block(with_ckpt: bool, base: int = 0):
+        for r in range(rounds):
+            m = fed.step()
+            if with_ckpt:
+                bg.save(base + r, fed.state)
+        if with_ckpt:
+            bg.flush()
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+
+    run_block(False)  # compile + warmup
+    run_block(True, base=10_000)  # warm the writer path too
+    modes = ("bare", "ckpt")
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        for mode in modes if rep % 2 == 0 else modes[::-1]:
+            t0 = time.perf_counter()
+            run_block(mode == "ckpt", base=20_000 + rep * rounds)
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = (med["ckpt"] - med["bare"]) / med["bare"] * 100.0
+    noise_floor_pct = (
+        (max(trials["bare"]) - min(trials["bare"])) / med["bare"] * 100.0
+    )
+
+    # Attributable cost: the exact loop-side call, writer idle each time.
+    save_walls = []
+    for i in range(timed_saves):
+        bg.flush()
+        t0 = time.perf_counter()
+        bg.save(30_000 + i, fed.state)
+        save_walls.append(time.perf_counter() - t0)
+    bg.flush()
+    async_call_ms = sorted(save_walls)[len(save_walls) // 2] * 1e3
+    # The synchronous contrast: one full inline save (encode + fsync'd
+    # write + verify + prune) on the loop.
+    sync_walls = []
+    for i in range(timed_saves):
+        t0 = time.perf_counter()
+        sync_ckpt.save(i, fed.state)
+        sync_walls.append(time.perf_counter() - t0)
+    sync_full_ms = sorted(sync_walls)[len(sync_walls) // 2] * 1e3
+    hist = reg.histogram("fedtpu_checkpoint_write_seconds", "")
+    writer_write_ms = (hist.sum / max(hist.count, 1)) * 1e3
+    state_bytes = (inner.last_save or {}).get("bytes", 0)
+    attributable_pct = (async_call_ms / 1e3) / med["bare"] * 100.0
+
+    bg.close()
+    shutil.rmtree(workdir, ignore_errors=True)
+    result = {
+        "metric": "checkpoint_overhead",
+        "unit": "% of round wall time attributable to the round-loop side "
+                "of one background checkpoint save per round",
+        "value": round(attributable_pct, 6),
+        "gate_pct": 1.0,
+        "passes_gate": bool(attributable_pct <= 1.0),
+        "per_save_ms": {
+            "async_call": round(async_call_ms, 3),
+            "sync_full": round(sync_full_ms, 3),
+            "writer_write": round(writer_write_ms, 3),
+        },
+        "checkpoint_bytes": int(state_bytes),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "timed_saves": timed_saves,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "CHECKPOINT_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 def _screening_overhead_microbench():
     """``screening_overhead``: what the fused Byzantine screening stage
     (:func:`fedtpu.ops.flat.screen_rows` — per-row L2 norm, cosine to the
@@ -1469,6 +1619,9 @@ def main():
         return
     if "--screening-overhead-microbench" in sys.argv:
         print(json.dumps(_screening_overhead_microbench()))
+        return
+    if "--checkpoint-overhead-microbench" in sys.argv:
+        print(json.dumps(_checkpoint_overhead_microbench()))
         return
     if "--cohort-scale" in sys.argv:
         print(json.dumps(_cohort_scale()))

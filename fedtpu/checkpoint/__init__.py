@@ -18,9 +18,20 @@ Two backends behind one API:
 
 from fedtpu.checkpoint.checkpoint import (
     Checkpointer,
+    atomic_write_bytes,
     latest_round,
     restore,
     save,
+    verify_generation,
 )
+from fedtpu.checkpoint.writer import BackgroundCheckpointer
 
-__all__ = ["Checkpointer", "latest_round", "restore", "save"]
+__all__ = [
+    "BackgroundCheckpointer",
+    "Checkpointer",
+    "atomic_write_bytes",
+    "latest_round",
+    "restore",
+    "save",
+    "verify_generation",
+]

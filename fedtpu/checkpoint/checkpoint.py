@@ -1,19 +1,59 @@
 """Checkpoint backends: orbax directory checkpoints or single-file wire blobs.
 
 Layout (wire backend):  ``<dir>/round_<N>.fckpt``  — one framed, CRC-checked
-file per round (see :mod:`fedtpu.transport.wire`). Layout (orbax backend):
+file per round (see :mod:`fedtpu.transport.wire`) plus a digest-bearing
+manifest ``round_<N>.fckpt.manifest.json`` recording the byte count and
+crc32 the write CLAIMED to make durable. Layout (orbax backend):
 ``<dir>/<N>/...`` per orbax's StandardCheckpointer. ``latest_round`` scans
 either layout; ``Checkpointer`` keeps at most ``keep`` snapshots, mirroring
 the reference's behavior of only ever retaining the latest
-``optimizedModel.pth`` (``src/server.py:174-179``) while fixing its inability
-to resume mid-run (the TODO at ``src/server.py:64``).
+``optimizedModel.pth`` (``src/server.py:174-179``) while fixing its
+inability to resume mid-run (the TODO at ``src/server.py:64``).
+
+Durability contract (the disaster-recovery spine, docs/OPERATIONS.md):
+
+- **Crash-consistent writes.** Every wire-backend generation is written to
+  a temp file, fsync'd, atomically renamed into place, and the DIRECTORY
+  fsync'd (rename atomicity alone does not make the rename durable — a
+  power cut can resurrect the old directory entry). The manifest follows
+  the same protocol, written only after its data file is durable, so a
+  manifest never vouches for bytes that were not yet on disk.
+- **Verify-on-read with multi-generation fallback.** ``restore`` checks
+  the manifest digest before decoding (and the wire CRC during decode);
+  :meth:`Checkpointer.restore_latest` treats a corrupt newest generation
+  (bit rot, torn write, truncation) as a FALLBACK event — logged, counted
+  into ``fedtpu_checkpoint_fallback_total``, flight-recorded — and
+  restores the previous generation instead of raising through ``--resume``
+  (the pre-hardening behavior: one flipped byte in the newest file made
+  the whole directory unusable). Template mismatches (an intact file whose
+  pytree does not match the caller's state) still raise: that is a config
+  problem, and silently restoring an OLDER generation would mask it.
+- **Non-fatal saves.** :meth:`Checkpointer.save` treats ``OSError``
+  (ENOSPC, EIO, a vanished mount) as a counted, flight-recorded warning —
+  ``fedtpu_checkpoint_save_failures_total`` — and returns ``None``:
+  training continues on the surviving generations rather than dying
+  because the checkpoint disk filled up.
+- **Prune only after a verified save.** Old generations are removed only
+  once the new one has been read back and digest-verified; a save that
+  cannot be verified leaves the previous generations — the recovery
+  lifeline — untouched.
+
+Seeded disk faults (``fedtpu.ft.chaos`` kinds ``ckpt_fail`` |
+``ckpt_torn`` | ``ckpt_rot`` on the pseudo-RPC ``Disk``) are consulted by
+:meth:`Checkpointer.save` when a schedule is armed, so the fallback and
+non-fatal paths above are chaos-testable against real files
+(``tools/chaos_soak.py --disaster``).
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
 import shutil
+import time
+import zlib
 from typing import Any, List, Optional
 
 import jax
@@ -23,32 +63,117 @@ from fedtpu.transport import wire
 
 Pytree = Any
 
+log = logging.getLogger("fedtpu.checkpoint")
+
 _WIRE_RE = re.compile(r"^round_(\d+)\.fckpt$")
+_MANIFEST_SUFFIX = ".manifest.json"
+_MANIFEST_FORMAT = "fckpt-manifest/1"
 
 
 def _wire_path(directory: str, round_idx: int) -> str:
     return os.path.join(directory, f"round_{round_idx}.fckpt")
 
 
+def _manifest_path(directory: str, round_idx: int) -> str:
+    return _wire_path(directory, round_idx) + _MANIFEST_SUFFIX
+
+
+def _fsync_dir(directory: str) -> None:
+    """Make a rename in ``directory`` durable (POSIX: the rename mutates
+    the directory inode, which has its own dirty state)."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return  # platforms that refuse O_RDONLY on dirs: best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """fsync'd atomic file replacement: temp write -> flush -> fsync(file)
+    -> rename -> fsync(directory). A crash at ANY point leaves either the
+    old file or the new one — never a torn mix — and a completed return
+    means the bytes survive power loss."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _write_manifest(directory: str, round_idx: int, payload: bytes) -> None:
+    manifest = {
+        "format": _MANIFEST_FORMAT,
+        "round": int(round_idx),
+        "bytes": len(payload),
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    atomic_write_bytes(
+        _manifest_path(directory, round_idx),
+        json.dumps(manifest).encode(),
+    )
+
+
+def verify_generation(directory: str, round_idx: int) -> bool:
+    """True iff the wire generation's on-disk bytes match its manifest
+    digest (or the pre-manifest legacy layout, where only the wire CRC can
+    vouch — checked at decode time instead). Raises nothing: any read
+    error reads as unverified."""
+    path = _wire_path(directory, round_idx)
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError:
+        return False
+    mpath = _manifest_path(directory, round_idx)
+    if not os.path.exists(mpath):
+        # Legacy generation (pre-manifest): defer to the wire CRC.
+        return True
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        return (
+            int(manifest["bytes"]) == len(data)
+            and int(manifest["crc32"]) == (zlib.crc32(data) & 0xFFFFFFFF)
+        )
+    except (OSError, ValueError, KeyError):
+        return False
+
+
 def save(directory: str, round_idx: int, state: Pytree, backend: str = "auto") -> str:
-    """Write one snapshot; returns its path. ``backend``: auto | orbax | wire."""
+    """Write one snapshot; returns its path. ``backend``: auto | orbax | wire.
+
+    The device->host transfer happens HERE for both backends (one
+    ``np.asarray`` map over the tree), so every caller — the synchronous
+    round loop and the background writer alike — blocks the device for
+    exactly the snapshot copy and nothing downstream ever holds device
+    buffers."""
     os.makedirs(directory, exist_ok=True)
+    host = jax.tree.map(np.asarray, state)
     if backend == "auto":
         backend = "orbax" if _orbax() is not None else "wire"
     if backend == "orbax":
         ocp = _orbax()
         path = os.path.join(os.path.abspath(directory), str(round_idx))
         ckptr = ocp.StandardCheckpointer()
-        host = jax.tree.map(np.asarray, state)
         ckptr.save(path, host, force=True)
         ckptr.wait_until_finished()
         return path
     if backend == "wire":
         path = _wire_path(directory, round_idx)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(wire.encode(state, compress=True))
-        os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+        payload = wire.encode(host, compress=True)
+        atomic_write_bytes(path, payload)
+        # Manifest last: it must never vouch for bytes that are not yet
+        # durable. Verify-on-read treats a data file without a manifest as
+        # legacy (wire-CRC-only), so a crash between the two writes
+        # degrades gracefully.
+        _write_manifest(directory, round_idx, payload)
         return path
     raise ValueError(f"unknown checkpoint backend '{backend}'")
 
@@ -56,7 +181,11 @@ def save(directory: str, round_idx: int, state: Pytree, backend: str = "auto") -
 def restore(
     directory: str, round_idx: int, like: Pytree, backend: str = "auto"
 ) -> Pytree:
-    """Load the snapshot for ``round_idx`` into the structure of ``like``."""
+    """Load the snapshot for ``round_idx`` into the structure of ``like``.
+
+    Wire generations are digest-verified against their manifest before the
+    decode (bit rot and torn writes fail HERE, as :class:`wire.WireError`,
+    not as a confusing msgpack error deep in flax)."""
     wire_p = _wire_path(directory, round_idx)
     orbax_p = os.path.join(os.path.abspath(directory), str(round_idx))
     if backend == "auto":
@@ -69,10 +198,17 @@ def restore(
         host_like = jax.tree.map(np.asarray, like)
         restored = ckptr.restore(orbax_p, host_like)
         return jax.tree.map(lambda l, r: np.asarray(r, l.dtype), host_like, restored)
+    if not verify_generation(directory, round_idx):
+        raise wire.WireError(
+            f"checkpoint generation {round_idx} in {directory} fails its "
+            "manifest digest (torn write or bit rot)"
+        )
     with open(wire_p, "rb") as fh:
         data = fh.read()
     try:
         return wire.decode(data, like)
+    except wire.WireError:
+        raise
     except ValueError:
         legacy = _legacy_decode(data, like)
         if legacy is not None:
@@ -132,28 +268,162 @@ class Checkpointer:
     >>> ckpt = Checkpointer("ckpt/", keep=3)
     >>> ckpt.save(round_idx, state)
     >>> state = ckpt.restore_latest(like=state)
+
+    ``metrics`` (a :class:`fedtpu.obs.MetricsRegistry`) and ``flight`` (a
+    :class:`fedtpu.obs.FlightRecorder`) hook the durability counters and
+    events; ``chaos`` (a :class:`fedtpu.ft.chaos.FaultSchedule`) arms the
+    seeded disk faults on the pseudo-RPC ``Disk``. ``strict=True`` restores
+    the old raise-on-save-failure behavior for callers that prefer it.
     """
 
-    def __init__(self, directory: str, keep: int = 3, backend: str = "auto"):
+    def __init__(self, directory: str, keep: int = 3, backend: str = "auto",
+                 metrics=None, flight=None, chaos=None, strict: bool = False):
         self.directory = directory
         self.keep = keep
         self.backend = backend
+        self.strict = strict
+        self._metrics = metrics
+        self._flight = flight
+        self._chaos = chaos
+        # Last successful save, for /statusz-style introspection:
+        # {round, bytes, wall_s}.
+        self.last_save: Optional[dict] = None
 
-    def save(self, round_idx: int, state: Pytree) -> str:
-        path = save(self.directory, round_idx, state, backend=self.backend)
+    # ------------------------------------------------------------- metrics
+    def _count(self, name: str, help_: str, amount: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(name, help_).inc(amount)
+
+    def _observe(self, name: str, help_: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.histogram(name, help_).observe(value)
+
+    # ---------------------------------------------------------------- save
+    def save(self, round_idx: int, state: Pytree) -> Optional[str]:
+        """Write + verify one generation, then prune. NON-FATAL: an OSError
+        (ENOSPC, EIO, vanished mount) or a verify-after-write failure is
+        logged, counted into ``fedtpu_checkpoint_save_failures_total`` and
+        flight-recorded, and ``None`` returned — the round loop keeps
+        training on the surviving generations. Old generations are pruned
+        ONLY after the new one verifies (the prune-after-verified-save
+        ordering: a bad write must never cost the recovery lifeline)."""
+        rule = None
+        if self._chaos is not None:
+            rule = self._chaos.decide("Disk")
+        t0 = time.monotonic()
+        try:
+            if rule is not None and rule.kind == "ckpt_fail":
+                raise OSError(28, "chaos: injected ENOSPC")  # errno.ENOSPC
+            path = save(self.directory, round_idx, state, backend=self.backend)
+            if self.backend != "orbax" and not verify_generation(
+                self.directory, round_idx
+            ):
+                raise OSError(
+                    f"checkpoint generation {round_idx} failed "
+                    "verify-after-write"
+                )
+        except OSError as exc:
+            log.warning(
+                "checkpoint save of round %d failed (%s); training "
+                "continues on the surviving generations", round_idx, exc,
+            )
+            self._count(
+                "fedtpu_checkpoint_save_failures_total",
+                "checkpoint saves that failed (ENOSPC/EIO/verify) — "
+                "non-fatal, training continues",
+            )
+            if self._flight is not None:
+                self._flight.record(
+                    "checkpoint", event="save_failed", round=round_idx,
+                    error=str(exc),
+                )
+            if self.strict:
+                raise
+            return None
+        wall = time.monotonic() - t0
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            pass
         self._prune()
+        self.last_save = {
+            "round": int(round_idx), "bytes": int(nbytes),
+            "wall_s": round(wall, 6),
+        }
+        self._count(
+            "fedtpu_checkpoint_saves_total",
+            "checkpoint generations written, verified, and made durable",
+        )
+        self._observe(
+            "fedtpu_checkpoint_write_seconds",
+            "wall seconds per checkpoint save (encode + fsync'd write + "
+            "verify)",
+            wall,
+        )
+        if self._metrics is not None:
+            self._metrics.gauge(
+                "fedtpu_checkpoint_bytes",
+                "on-disk bytes of the most recent checkpoint generation",
+            ).set(nbytes)
+        # Post-verification silent corruption (ckpt_torn | ckpt_rot): the
+        # fault models a disk that ACKNOWLEDGED the write and lost or
+        # flipped bits afterwards — invisible to the writer, caught only by
+        # restore-time verification. Applied after the metrics above:
+        # the save legitimately looked successful to this process.
+        if rule is not None and rule.kind in ("ckpt_torn", "ckpt_rot"):
+            _corrupt_generation(self.directory, round_idx, rule.kind)
         return path
 
     def restore(self, round_idx: int, like: Pytree) -> Pytree:
         return restore(self.directory, round_idx, like, backend=self.backend)
 
     def restore_latest(self, like: Pytree) -> Optional[tuple]:
-        """(round_idx, state) of the newest snapshot, or None if empty —
-        the ``--resume`` path (reference: ``src/main.py:87-96``)."""
-        r = latest_round(self.directory)
-        if r is None:
+        """(round_idx, state) of the newest VERIFIED snapshot, or None for
+        an empty directory — the ``--resume`` path (reference:
+        ``src/main.py:87-96``).
+
+        A corrupt generation (manifest digest mismatch, wire CRC failure,
+        truncation, unreadable file) falls back to the previous one:
+        logged, counted into ``fedtpu_checkpoint_fallback_total``,
+        flight-recorded. Template mismatches (intact bytes that do not
+        match ``like``'s structure) raise — a config problem the operator
+        must see, not a disk fault to skip past. Raises
+        :class:`wire.WireError` when generations exist but ALL fail
+        verification, so a resume never silently restarts from scratch.
+        Requires ``keep >= 2`` (or unbounded retention, ``keep <= 0``):
+        fallback needs a previous generation to exist."""
+        if 0 < self.keep < 2:
+            raise ValueError(
+                f"resuming requires keep >= 2 (got keep={self.keep}): "
+                "generation fallback needs a previous snapshot to fall "
+                "back to"
+            )
+        rounds = _scan_rounds(self.directory)
+        if not rounds:
             return None
-        return r, self.restore(r, like)
+        for r in reversed(rounds):
+            try:
+                return r, self.restore(r, like)
+            except (wire.WireError, OSError) as exc:
+                log.error(
+                    "checkpoint generation %d is corrupt (%s); falling "
+                    "back to the previous generation", r, exc,
+                )
+                self._count(
+                    "fedtpu_checkpoint_fallback_total",
+                    "restore-time fallbacks past a corrupt checkpoint "
+                    "generation (torn write / bit rot)",
+                )
+                if self._flight is not None:
+                    self._flight.record(
+                        "checkpoint", event="fallback", round=r,
+                        error=str(exc),
+                    )
+        raise wire.WireError(
+            f"all {len(rounds)} checkpoint generations in "
+            f"{self.directory} failed verification"
+        )
 
     def _prune(self) -> None:
         rounds = _scan_rounds(self.directory)
@@ -162,8 +432,52 @@ class Checkpointer:
             dp = os.path.join(self.directory, str(r))
             if os.path.exists(wp):
                 os.remove(wp)
+            mp = _manifest_path(self.directory, r)
+            if os.path.exists(mp):
+                os.remove(mp)
             if os.path.isdir(dp):
                 shutil.rmtree(dp, ignore_errors=True)
+
+    def status(self) -> dict:
+        """Introspection block (CLI /statusz): directory + last save."""
+        return {
+            "directory": self.directory,
+            "keep": self.keep,
+            "last_save": self.last_save,
+        }
+
+    # Lifecycle no-ops, so callers hold one surface whether saves are
+    # synchronous or routed through the BackgroundCheckpointer wrapper.
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        return None
+
+
+def _corrupt_generation(directory: str, round_idx: int, kind: str) -> None:
+    """Apply a seeded SILENT disk fault to a written generation: the
+    manifest keeps claiming the intended bytes, so only restore-time
+    verification can notice — exactly the failure mode the fallback path
+    exists for. ``ckpt_torn`` truncates the file to half (an acknowledged
+    write the filesystem lost the tail of); ``ckpt_rot`` flips one byte in
+    the middle (media bit rot)."""
+    path = _wire_path(directory, round_idx)
+    try:
+        size = os.path.getsize(path)
+        if size < 2:
+            return
+        if kind == "ckpt_torn":
+            with open(path, "r+b") as fh:
+                fh.truncate(size // 2)
+        else:
+            with open(path, "r+b") as fh:
+                fh.seek(size // 2)
+                byte = fh.read(1)
+                fh.seek(size // 2)
+                fh.write(bytes((byte[0] ^ 0xFF,)))
+    except OSError:
+        pass
 
 
 def _orbax():

@@ -1,0 +1,162 @@
+"""Background checkpoint writer: saves off the round loop.
+
+The synchronous path (``Checkpointer.save`` on the round loop) blocks the
+loop for encode + fsync'd write + verify — milliseconds for an MLP, but a
+full serialize-and-fsync of a real model is round-scale work the loop
+should not wait for. :class:`BackgroundCheckpointer` splits the save at
+the only point that MUST happen on the loop: the device->host snapshot
+(``np.asarray`` over the state tree — the copy that pins the values the
+checkpoint claims to capture). Everything after — wire encode, atomic
+write, manifest, verify, prune — runs on one daemon writer thread under a
+``checkpoint`` span.
+
+Ordering guarantees:
+
+- Saves are applied strictly in submission order (single writer thread,
+  FIFO queue), so generation N on disk never predates generation N-1.
+- The inner :class:`~fedtpu.checkpoint.checkpoint.Checkpointer` prunes
+  only after each generation verifies, and its save errors are non-fatal
+  (counted, flight-recorded) — a full disk degrades durability, never
+  liveness, and never kills the writer thread.
+- The queue is bounded (``queue_depth``): if the writer falls behind, the
+  round loop blocks on the NEXT save instead of accumulating unbounded
+  host snapshots — backpressure, not a leak.
+
+``flush()`` drains pending saves (call before reading the directory back
+in-process); ``close()`` drains and stops the thread — the CLIs call it
+from their exit path so the final generation is durable before the
+process exits.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from fedtpu.checkpoint.checkpoint import Checkpointer
+
+Pytree = Any
+
+log = logging.getLogger("fedtpu.checkpoint")
+
+_STOP = object()
+
+
+class BackgroundCheckpointer:
+    """Same ``save(round_idx, state)`` surface as :class:`Checkpointer`,
+    with the write moved to a background thread. ``telemetry`` (a
+    :class:`fedtpu.obs.Telemetry`, optional) wraps each write in a
+    ``checkpoint`` span so traces show the writer's wall time next to the
+    round loop it no longer blocks."""
+
+    def __init__(self, inner: Checkpointer, telemetry=None,
+                 queue_depth: int = 2):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.inner = inner
+        self._telemetry = telemetry
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        # Pending-save accounting (NOT the queue size: the item currently
+        # being written has left the queue but is not yet durable).
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._drained = threading.Condition(self._lock)
+        self._thread = threading.Thread(
+            target=self._run, name="fedtpu-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- surface
+    @property
+    def directory(self) -> str:
+        return self.inner.directory
+
+    @property
+    def last_save(self) -> Optional[dict]:
+        return self.inner.last_save
+
+    def save(self, round_idx: int, state: Pytree) -> None:
+        """Snapshot-to-host NOW (the only device-blocking step — both save
+        paths block the device identically, see ``checkpoint.save``), then
+        hand the host tree to the writer. Blocks only when the bounded
+        queue is full (writer behind by ``queue_depth`` generations).
+
+        The snapshot is a FORCED copy, never a view: on CPU,
+        ``np.asarray`` of a jax array can alias the device buffer, and the
+        engines' round steps DONATE their state (``donate_argnums``) — a
+        zero-copy view would silently observe the NEXT round's bytes by
+        the time the writer serializes it. The copy is the price of the
+        crash-consistency claim and is exactly what the microbench's
+        ``async_call`` headline times."""
+        host = jax.tree.map(lambda l: np.array(l, copy=True), state)
+        with self._lock:
+            self._pending += 1
+        self._q.put((int(round_idx), host))
+
+    def restore(self, round_idx: int, like: Pytree) -> Pytree:
+        self.flush()
+        return self.inner.restore(round_idx, like)
+
+    def restore_latest(self, like: Pytree):
+        self.flush()
+        return self.inner.restore_latest(like)
+
+    def status(self) -> dict:
+        s = self.inner.status()
+        s["async"] = True
+        with self._lock:
+            s["pending"] = self._pending
+        return s
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted save has been written (or failed
+        non-fatally). True on drained, False on timeout."""
+        with self._drained:
+            return self._drained.wait_for(
+                lambda: self._pending == 0, timeout
+            )
+
+    def close(self, timeout: Optional[float] = 60.0) -> None:
+        """Drain and stop the writer. Idempotent."""
+        if not self._thread.is_alive():
+            return
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            log.warning("checkpoint writer did not drain within %ss", timeout)
+
+    # -------------------------------------------------------------- worker
+    def _span(self, round_idx: int):
+        if self._telemetry is not None:
+            return self._telemetry.span("checkpoint", round=round_idx)
+        from contextlib import nullcontext
+
+        return nullcontext()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            round_idx, host = item
+            try:
+                with self._span(round_idx):
+                    # Inner save is non-fatal by design; anything else
+                    # escaping here must not kill the writer thread.
+                    self.inner.save(round_idx, host)
+            except Exception:
+                log.exception(
+                    "background checkpoint save of round %d raised",
+                    round_idx,
+                )
+            finally:
+                with self._drained:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._drained.notify_all()

@@ -66,6 +66,17 @@ def main(argv=None) -> int:
         "after the client; Join retries with backoff until then)",
     )
     p.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="persist this client's local training state (round counter, "
+        "optimizer moments, PRNG stream, error-feedback residual) per "
+        "round under DIR via the hardened generational checkpoint store, "
+        "and restore it on startup: a restarted client then RESUMES its "
+        "trajectory instead of silently diverging (fresh residual, "
+        "replayed batch draws). The server still resyncs the weights; "
+        "this covers the state only this process holds "
+        "(docs/OPERATIONS.md §Disaster recovery)",
+    )
+    p.add_argument(
         "--leave-on-exit", action="store_true",
         help="send Leave(address) to the --join gate on shutdown, so the "
         "coordinator evicts this client (freeing its seat) instead of "
@@ -81,6 +92,7 @@ def main(argv=None) -> int:
     server, agent = serve_client(
         args.address, cfg, seed=args.seed, compress=compress_enabled(args),
         chaos=make_chaos(args, role=f"client-{args.address}"),
+        state_dir=args.state_dir,
     )
     # A client agent exits via signal (it serves until terminated), so the
     # exporters ONLY fire through the SIGTERM/atexit flush.

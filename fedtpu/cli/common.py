@@ -521,6 +521,59 @@ def robustness_config(args) -> dict:
     }
 
 
+def add_checkpoint_hardening_flags(p: argparse.ArgumentParser) -> None:
+    """Durability knobs shared by the CLIs that own a --checkpoint-dir
+    (docs/OPERATIONS.md §Disaster recovery)."""
+    p.add_argument(
+        "--checkpoint-keep",
+        default=3,
+        type=int,
+        metavar="N",
+        help="checkpoint generations retained on disk (pruned only after "
+        "the newest verifies). Resume requires >= 2: restore-time "
+        "generation fallback needs a previous snapshot to fall back to "
+        "when the newest is torn or bit-rotten. <= 0 keeps everything",
+    )
+    p.add_argument(
+        "--checkpoint-sync",
+        action="store_true",
+        help="write checkpoints synchronously on the round loop instead "
+        "of the default background writer thread (the loop then blocks "
+        "for encode + fsync + verify each save; the writer path blocks "
+        "only for the device->host snapshot — bench.py "
+        "--checkpoint-overhead-microbench)",
+    )
+
+
+def make_checkpointer(args, telemetry=None, flight=None, chaos=None):
+    """Honor --checkpoint-dir: a hardened Checkpointer (fsync'd atomic
+    writes, digest manifests, verify-on-read generation fallback,
+    non-fatal saves), wrapped in the BackgroundCheckpointer writer thread
+    unless --checkpoint-sync. None when the flag is absent. The caller
+    owns ``close()`` (drains the writer so the final generation is durable
+    before exit). ``chaos`` arms the seeded ckpt_fail/ckpt_torn/ckpt_rot
+    disk faults of --chaos-spec against this store."""
+    directory = getattr(args, "checkpoint_dir", None)
+    if not directory:
+        return None
+    from fedtpu.checkpoint import BackgroundCheckpointer, Checkpointer
+
+    inner = Checkpointer(
+        directory,
+        keep=getattr(args, "checkpoint_keep", 3),
+        backend="wire",
+        metrics=(
+            telemetry.registry
+            if telemetry is not None and telemetry.enabled else None
+        ),
+        flight=flight,
+        chaos=chaos,
+    )
+    if getattr(args, "checkpoint_sync", False):
+        return inner
+    return BackgroundCheckpointer(inner, telemetry=telemetry)
+
+
 def make_chaos(args, role: str = ""):
     """Honor --chaos-spec: parse + arm a FaultSchedule (None when absent).
     The armed rules are logged so a soak's transcript names its faults."""

@@ -14,8 +14,8 @@ import time
 
 import numpy as np
 
-from fedtpu.checkpoint import Checkpointer
 from fedtpu.cli.common import (
+    add_checkpoint_hardening_flags,
     add_fed_flags,
     add_model_flags,
     add_obs_flags,
@@ -27,6 +27,7 @@ from fedtpu.cli.common import (
     build_config,
     install_final_flush,
     make_chaos,
+    make_checkpointer,
     make_flight_recorder,
     start_obs_server,
 )
@@ -99,6 +100,7 @@ def main(argv=None) -> int:
     add_robustness_flags(p)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
+    add_checkpoint_hardening_flags(p)
     p.add_argument("-r", "--resume", action="store_true")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the rounds here")
@@ -143,7 +145,16 @@ def main(argv=None) -> int:
     else:
         fed = Federation(cfg, seed=args.seed, mesh=_auto_mesh(args))
 
-    ckpt, start_round, state = _restore_from(args, like=fed.state)
+    # The simulated engine has no RPC edge; chaos here means crash/latency
+    # drills — delay/kill rules on the pseudo-RPC "Round", once per block —
+    # plus the ckpt_* disk faults against the checkpoint store below.
+    chaos = make_chaos(args, role="engine")
+    logger = RoundRecordWriter(path=args.metrics, echo=not args.progress)
+    flight = make_flight_recorder("engine", telemetry=fed.telemetry)
+    ckpt, start_round, state = _restore_from(
+        args, like=fed.state, telemetry=fed.telemetry, flight=flight,
+        chaos=chaos,
+    )
     if state is not None:
         import jax
         import jax.numpy as jnp
@@ -152,8 +163,6 @@ def main(argv=None) -> int:
         fed.state = jax.tree.map(jnp.asarray, state)
         logging.info("resumed from round %d", start_round)
 
-    logger = RoundRecordWriter(path=args.metrics, echo=not args.progress)
-    flight = make_flight_recorder("engine", telemetry=fed.telemetry)
     flush = install_final_flush(args, fed.telemetry, metrics=logger)
     obs = start_obs_server(
         args,
@@ -169,9 +178,6 @@ def main(argv=None) -> int:
     bar = (
         ProgressBar(cfg.fed.num_rounds - start_round) if args.progress else None
     )
-    # The simulated engine has no RPC edge; chaos here means crash/latency
-    # drills — delay/kill rules on the pseudo-RPC "Round", once per block.
-    chaos = make_chaos(args, role="engine")
     t0 = time.time()
     with profile_rounds(args.profile_dir):
         r = start_round
@@ -253,6 +259,8 @@ def main(argv=None) -> int:
     logging.info(
         "%d rounds in %.1fs (%.2f rounds/s)", done, dt, done / max(dt, 1e-9)
     )
+    if ckpt is not None:
+        ckpt.close()  # drain the background writer before reporting done
     # Idempotent with the atexit/SIGTERM registration — crash paths flush
     # the same way this clean exit does.
     flush()
@@ -261,14 +269,19 @@ def main(argv=None) -> int:
     return 0
 
 
-def _restore_from(args, like):
+def _restore_from(args, like, telemetry=None, flight=None, chaos=None):
     """Shared --checkpoint-dir/-r machinery for the sync and async loops:
-    ``(checkpointer | None, start_index, restored_state | None)``. Callers
-    install the state themselves — the engines differ (Federation's state
-    setter vs AsyncFederation.load_state), both mesh-aware."""
-    if not args.checkpoint_dir:
+    ``(checkpointer | None, start_index, restored_state | None)``. The
+    checkpointer is the hardened store (fsync + manifests + generation
+    fallback on restore, disk-chaos hooks), wrapped in the background
+    writer unless --checkpoint-sync. Callers install the state themselves
+    — the engines differ (Federation's state setter vs
+    AsyncFederation.load_state), both mesh-aware — and own ``close()``."""
+    ckpt = make_checkpointer(
+        args, telemetry=telemetry, flight=flight, chaos=chaos,
+    )
+    if ckpt is None:
         return None, 0, None
-    ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
     if not args.resume:
         return ckpt, 0, None
     latest = ckpt.restore_latest(like=like)
@@ -309,12 +322,16 @@ def _run_async(args, cfg) -> int:
         mesh=_auto_mesh(args),
         staleness_damping=args.staleness_damping == "on",
     )
-    ckpt, start_tick, state = _restore_from(args, like=fed.state)
+    chaos = make_chaos(args, role="async_engine")
+    logger = RoundRecordWriter(path=args.metrics, echo=True)
+    flight = make_flight_recorder("async_engine", telemetry=fed.telemetry)
+    ckpt, start_tick, state = _restore_from(
+        args, like=fed.state, telemetry=fed.telemetry, flight=flight,
+        chaos=chaos,
+    )
     if state is not None:
         fed.load_state(state)  # async re-placement (mesh-aware)
         logging.info("resumed async state from update %d", start_tick)
-    logger = RoundRecordWriter(path=args.metrics, echo=True)
-    flight = make_flight_recorder("async_engine", telemetry=fed.telemetry)
     flush = install_final_flush(args, fed.telemetry, metrics=logger)
     obs = start_obs_server(
         args,
@@ -329,23 +346,25 @@ def _run_async(args, cfg) -> int:
 
     t0 = time.time()
     with profile_rounds(args.profile_dir):
-        _async_loop(args, fed, logger, eval_data, ckpt, start_tick)
+        _async_loop(args, fed, logger, eval_data, ckpt, start_tick, chaos)
     dt = time.time() - t0
     done = max(0, args.async_updates - start_tick)  # executed THIS run
     logging.info(
         "%d async updates in %.1fs (%.2f updates/s)",
         done, dt, done / max(dt, 1e-9),
     )
+    if ckpt is not None:
+        ckpt.close()
     flush()
     if obs is not None:
         obs.stop()
     return 0
 
 
-def _async_loop(args, fed, logger, eval_data, ckpt=None, start_tick=0) -> None:
+def _async_loop(args, fed, logger, eval_data, ckpt=None, start_tick=0,
+                chaos=None) -> None:
     # Same resume semantics as the sync loop: --async-updates is the TOTAL
     # update count, a resumed run finishes the remainder.
-    chaos = make_chaos(args, role="async_engine")
     t = start_tick
     while t < args.async_updates:
         if chaos is not None:
@@ -382,9 +401,9 @@ def _async_loop(args, fed, logger, eval_data, ckpt=None, start_tick=0) -> None:
                 > (t - block) // args.checkpoint_every
             )
             if crossed_ckpt or t >= args.async_updates:
-                import jax
-
-                ckpt.save(t, jax.tree.map(np.asarray, fed.state))
+                # checkpoint.save owns the host transfer for every caller
+                # (and the background writer snapshots before enqueue).
+                ckpt.save(t, fed.state)
 
 
 if __name__ == "__main__":

@@ -13,11 +13,8 @@ import argparse
 import logging
 import time
 
-import jax
-import jax.numpy as jnp
-
-from fedtpu.checkpoint import Checkpointer
 from fedtpu.cli.common import (
+    add_checkpoint_hardening_flags,
     add_fed_flags,
     add_model_flags,
     add_obs_flags,
@@ -29,10 +26,11 @@ from fedtpu.cli.common import (
     compress_enabled,
     install_final_flush,
     make_chaos,
+    make_checkpointer,
     make_flight_recorder,
     start_obs_server,
 )
-from fedtpu.transport.federation import BackupServer, PrimaryServer, _model_template
+from fedtpu.transport.federation import BackupServer, PrimaryServer
 
 
 def main(argv=None) -> int:
@@ -51,6 +49,7 @@ def main(argv=None) -> int:
         help="comma-separated client registry (reference default)",
     )
     p.add_argument("--checkpoint-dir", default=None)
+    add_checkpoint_hardening_flags(p)
     p.add_argument(
         "--gate", default=None, metavar="HOST:PORT",
         help="host the membership gate on this address (primary role): a "
@@ -121,6 +120,7 @@ def main(argv=None) -> int:
         # Process-wide black box: armed before anything can fail, handed to
         # the server so spans/rounds/FT events feed the same ring.
         flight = make_flight_recorder("primary")
+        chaos = make_chaos(args, role="primary")
         primary = PrimaryServer(
             cfg,
             clients,
@@ -128,52 +128,27 @@ def main(argv=None) -> int:
             compress=compress,
             round_deadline_s=args.round_deadline,
             flight=flight,
-            chaos=make_chaos(args, role="primary"),
+            chaos=chaos,
         )
-        ckpt = None
+        # One hardened checkpoint store (fsync + manifests + generation
+        # fallback; background writer unless --checkpoint-sync), sharing
+        # the primary's metrics registry, flight recorder and chaos
+        # schedule — the disk is part of the same failure domain.
+        ckpt = make_checkpointer(
+            args, telemetry=primary.telemetry, flight=flight, chaos=chaos,
+        )
         start_round = 0
-        if args.checkpoint_dir:
-            ckpt = Checkpointer(args.checkpoint_dir, backend="wire")
-            if args.resume:
-                # Full server state (model + round counter + membership +
-                # FedOpt moments); pre-membership checkpoints restore under
-                # the legacy template (keeping the startup roster), and
-                # legacy model-only checkpoints still restore with the
-                # counter estimated from the checkpoint index.
-                try:
-                    latest = ckpt.restore_latest(primary.state_template())
-                except ValueError:
-                    try:
-                        latest = ckpt.restore_latest(
-                            primary.state_template(membership=False)
-                        )
-                    except ValueError:
-                        latest = None
-                if latest is None:
-                    params, stats = _model_template(primary.model, cfg)
-                    legacy = ckpt.restore_latest(
-                        {"params": params, "batch_stats": stats}
-                    )
-                    latest = None
-                    if legacy is not None:
-                        r, tree = legacy
-                        primary.params = jax.tree.map(
-                            jnp.asarray, tree["params"]
-                        )
-                        primary.batch_stats = jax.tree.map(
-                            jnp.asarray, tree["batch_stats"]
-                        )
-                        primary._round_counter = r + 1
-                        start_round = r + 1
-                        logging.info(
-                            "resumed legacy model-only checkpoint from "
-                            "round %d", r,
-                        )
-                if latest is not None:
-                    r, tree = latest
-                    primary.install_state(tree)
-                    start_round = r + 1
-                    logging.info("resumed global model from round %d", r)
+        if ckpt is not None and args.resume:
+            # Cold-start recovery: full server state (model + lineage
+            # counter + membership roster incl. reputation + FedOpt
+            # moments) from the newest VERIFIED generation, falling back
+            # past torn/bit-rotten ones; pre-membership and legacy
+            # model-only checkpoints restore through the template ladder.
+            start_round = primary.restore_from_checkpoint(ckpt) or 0
+            if start_round:
+                logging.info(
+                    "resumed global model from round %d", start_round - 1
+                )
         from fedtpu.obs import RoundRecordWriter
 
         metrics = RoundRecordWriter(path=args.metrics) if args.metrics else None
@@ -214,6 +189,10 @@ def main(argv=None) -> int:
                     on_round=on_round,
                 )
         finally:
+            if ckpt is not None:
+                # Drain the background writer FIRST: the final generation
+                # must be durable before the process reports done.
+                ckpt.close()
             flush()
             primary.stop_gate()
             if obs is not None:

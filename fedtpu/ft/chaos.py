@@ -33,6 +33,14 @@ by the wire interceptors; they count into
 ``fedtpu_attack_injected_total{kind}``. See docs/FAULT_TOLERANCE.md
 §Threat model.
 
+Disk faults (``DISK_KINDS``: ``ckpt_fail`` | ``ckpt_torn`` | ``ckpt_rot``)
+are a third class, keyed on the pseudo-RPC ``Disk`` and consulted once per
+:meth:`fedtpu.checkpoint.Checkpointer.save` — the chaos surface of the
+durability stack (write failures, torn writes, silent bit rot; see
+docs/FAULT_TOLERANCE.md §Durability and ``tools/chaos_soak.py
+--disaster``). Like attacks, they never fire from wire interceptors and
+wildcard wire rules never fire on the disk consult.
+
 Determinism: each (rule, rpc, peer) triple keeps its own draw counter, and
 the n-th draw fires iff ``crc32(f"{seed}|{rule}|{rpc}|{peer}|{n}") / 2^32 <
 p``. The decision therefore depends only on the seed and on that peer's own
@@ -72,6 +80,17 @@ from typing import Dict, List, Optional, Tuple
 log = logging.getLogger("fedtpu.chaos")
 
 WIRE_KINDS = ("delay", "drop", "error", "corrupt", "kill")
+# Seeded disk faults against the checkpoint store (the durability fault
+# class): consulted by fedtpu.checkpoint.Checkpointer.save via the
+# pseudo-RPC "Disk" — never by the wire interceptors. ckpt_fail raises
+# ENOSPC at write time (the non-fatal-save path: counted, training
+# continues); ckpt_torn truncates the WRITTEN generation to half and
+# ckpt_rot flips a byte in it AFTER the writer verified — both model a
+# disk that acknowledged the write and lost/flipped bits later, so only
+# restore-time manifest verification (and the multi-generation fallback)
+# can catch them. The disaster soak (tools/chaos_soak.py --disaster) is
+# built on these.
+DISK_KINDS = ("ckpt_fail", "ckpt_torn", "ckpt_rot")
 # Model-level Byzantine attacks (the well-formed-but-malicious fault
 # class): executed inside LocalTrainer against the update itself, never by
 # the wire interceptors. Keyed on the pseudo-RPC "Attack" with peer = the
@@ -82,12 +101,12 @@ WIRE_KINDS = ("delay", "drop", "error", "corrupt", "kill")
 # training labels by `offset` classes. The simulated twin is
 # fedtpu.sim.adversary (SimConfig.malicious_fraction).
 ATTACK_KINDS = ("sign_flip", "scale", "noise", "label_flip")
-KINDS = WIRE_KINDS + ATTACK_KINDS
-# The service's RPC surface plus the engine loops' pseudo-RPC and the
-# model-level attack consult.
+KINDS = WIRE_KINDS + ATTACK_KINDS + DISK_KINDS
+# The service's RPC surface plus the engine loops' pseudo-RPC, the
+# model-level attack consult, and the checkpoint store's disk consult.
 RPC_NAMES = (
     "StartTrain", "SendModel", "HeartBeat", "CheckIfPrimaryUp",
-    "FetchModel", "Round", "Attack", "*",
+    "FetchModel", "Round", "Attack", "Disk", "*",
 )
 
 
@@ -131,6 +150,10 @@ class FaultRule:
     def is_attack(self) -> bool:
         return self.kind in ATTACK_KINDS
 
+    @property
+    def is_disk(self) -> bool:
+        return self.kind in DISK_KINDS
+
     def validate(self) -> "FaultRule":
         if self.kind not in KINDS:
             raise ValueError(
@@ -145,6 +168,17 @@ class FaultRule:
                 f"attack kind {self.kind!r} applies to the model update, "
                 "not an RPC — leave rpc unset (it keys on the pseudo-RPC "
                 "'Attack')"
+            )
+        if self.is_disk and self.rpc not in ("Disk", "*"):
+            raise ValueError(
+                f"disk kind {self.kind!r} applies to the checkpoint "
+                "store, not an RPC — leave rpc unset (it keys on the "
+                "pseudo-RPC 'Disk')"
+            )
+        if self.kind in WIRE_KINDS and self.rpc in ("Attack", "Disk"):
+            raise ValueError(
+                f"wire kind {self.kind!r} cannot target the pseudo-RPC "
+                f"{self.rpc!r} (kind classes never cross)"
             )
         if self.kind == "scale" and self.factor == 0.0:
             raise ValueError("scale attack factor must be nonzero")
@@ -201,9 +235,11 @@ class FaultSchedule:
     # ---------------------------------------------------------- decision
     def _matches(self, rule: FaultRule, rpc: str, peer: str) -> bool:
         # Kind classes never cross: a wildcard wire rule (error@*) must not
-        # fire on the model-update consult, and an attack rule must never
-        # inject into a wire interceptor.
+        # fire on the model-update or disk consults, and an attack/disk
+        # rule must never inject into a wire interceptor.
         if rule.is_attack != (rpc == "Attack"):
+            return False
+        if rule.is_disk != (rpc == "Disk"):
             return False
         if rule.rpc != "*" and rule.rpc != rpc:
             return False
@@ -600,10 +636,13 @@ def _parse_dsl(spec: str) -> FaultSchedule:
 
 
 def _rule_from(fields: dict) -> FaultRule:
-    # Attack kinds key on the pseudo-RPC "Attack"; a bare `sign_flip:p=1`
-    # spec normalizes there so authors never have to spell it.
+    # Attack kinds key on the pseudo-RPC "Attack" and disk kinds on
+    # "Disk"; a bare `sign_flip:p=1` / `ckpt_rot:p=1` spec normalizes
+    # there so authors never have to spell it.
     if fields.get("kind") in ATTACK_KINDS and fields.get("rpc", "*") == "*":
         fields["rpc"] = "Attack"
+    if fields.get("kind") in DISK_KINDS and fields.get("rpc", "*") == "*":
+        fields["rpc"] = "Disk"
     if "rounds" in fields and not isinstance(fields["rounds"], (tuple, list)):
         lo, dash, hi = str(fields["rounds"]).partition("-")
         fields["rounds"] = (int(lo), int(hi)) if dash else (

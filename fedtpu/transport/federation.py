@@ -109,7 +109,12 @@ class LocalTrainer:
     its torch optimizer alive in the module global, ``src/main.py:99``).
     """
 
-    def __init__(self, cfg: RoundConfig, seed: int = 0):
+    # Per-round local-state snapshots retained for coordinator-replay
+    # rollback (see _train_round_impl): bounded ring, newest rounds win.
+    SNAPSHOT_KEEP = 4
+
+    def __init__(self, cfg: RoundConfig, seed: int = 0,
+                 state_dir: Optional[str] = None):
         self.cfg = cfg
         self.telemetry = Telemetry(cfg.fed.telemetry, role="client")
         n_classes = dataset_info(cfg.data.dataset)[1]
@@ -155,6 +160,26 @@ class LocalTrainer:
                 {"params": self.params, "batch_stats": self.batch_stats}
             )
         )
+        # Cold-start client durability (docs/OPERATIONS.md §Disaster
+        # recovery): the server resyncs a restarted client's WEIGHTS, but
+        # the local round counter, optimizer moments, PRNG stream, and the
+        # edge error-feedback residual live only in this process — losing
+        # them silently diverges the client (a fresh residual re-injects
+        # mass top-k already shipped; a reset round counter replays old
+        # batch draws). With ``state_dir`` set, that local state persists
+        # per round through the hardened generational Checkpointer (fsync
+        # + manifest + fallback) and restores on construction.
+        self._snapshots: Dict[int, dict] = {}
+        self._state_ckpt = None
+        if state_dir:
+            from fedtpu.checkpoint import Checkpointer
+
+            self._state_ckpt = Checkpointer(
+                state_dir, keep=3, backend="wire",
+                metrics=self.telemetry.registry if self.telemetry.enabled
+                else None,
+            )
+            self._restore_client_state()
 
     def _shard(self, rank: int, world: int):
         """This client's rows of the deterministic ``world``-way partition.
@@ -177,20 +202,151 @@ class LocalTrainer:
             raise ValueError(f"unknown partition {cfg.data.partition}")
         return idx[rank : rank + 1], mask[rank : rank + 1]
 
+    # ------------------------------------------------- local-state durability
+    def _residual_template(self) -> dict:
+        return {
+            "params": jax.tree.map(
+                lambda l: np.zeros(l.shape, l.dtype), self.params
+            ),
+            "batch_stats": jax.tree.map(
+                lambda l: np.zeros(l.shape, l.dtype), self.batch_stats
+            ),
+        }
+
+    def _client_state(self) -> dict:
+        """The client-local state one wire blob must capture for a cold
+        restart to RESUME rather than diverge: the local round counter,
+        PRNG key, optimizer moments, and the error-feedback residual
+        (``has_residual`` distinguishes "no residual yet" from a zero
+        residual)."""
+        residual = self.edge_residual
+        return {
+            "round_idx": np.asarray(self.round_idx, np.int64),
+            "rng": np.asarray(self.rng),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "has_residual": np.asarray(
+                0 if residual is None else 1, np.int8
+            ),
+            "residual": (
+                jax.tree.map(np.asarray, residual)
+                if residual is not None else self._residual_template()
+            ),
+        }
+
+    def _install_client_state(self, tree: dict) -> None:
+        self.round_idx = int(tree["round_idx"])
+        self.rng = jnp.asarray(tree["rng"])
+        self.opt_state = jax.tree.map(jnp.asarray, tree["opt_state"])
+        self.edge_residual = (
+            jax.tree.map(np.asarray, tree["residual"])
+            if int(tree["has_residual"]) else None
+        )
+
+    def _restore_client_state(self) -> None:
+        try:
+            latest = self._state_ckpt.restore_latest(self._client_state())
+        except (ValueError, OSError) as exc:
+            log.warning(
+                "client state in %s unusable (%s); starting fresh",
+                self._state_ckpt.directory, exc,
+            )
+            return
+        if latest is None:
+            return
+        r, tree = latest
+        self._install_client_state(tree)
+        # Seed the rollback ring with the restored cut, so a coordinator
+        # replaying exactly this round (the common recovery alignment)
+        # needs no further unwinding.
+        self._snapshot_round(self.round_idx)
+        log.info(
+            "client state restored: resuming at local round %d "
+            "(residual=%s)", self.round_idx,
+            "yes" if self.edge_residual is not None else "no",
+        )
+
+    def _persist_client_state(self) -> None:
+        if self._state_ckpt is not None:
+            # Non-fatal by construction (hardened Checkpointer): a full
+            # state disk degrades the client's restartability, never its
+            # participation in the current round.
+            self._state_ckpt.save(self.round_idx, self._client_state())
+
+    def _snapshot_round(self, round_idx: int) -> None:
+        """Host snapshot of the round-START local state, for replay
+        rollback. Ring-bounded: older than SNAPSHOT_KEEP rounds falls off
+        (a deeper replay than the checkpoint keep-window cannot happen —
+        the coordinator's own fallback is bounded by its retention)."""
+        self._snapshots[round_idx] = {
+            "params": jax.tree.map(np.asarray, self.params),
+            "batch_stats": jax.tree.map(np.asarray, self.batch_stats),
+            "rng": np.asarray(self.rng),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "residual": (
+                jax.tree.map(np.asarray, self.edge_residual)
+                if self.edge_residual is not None else None
+            ),
+        }
+        for r in sorted(self._snapshots):
+            if len(self._snapshots) <= self.SNAPSHOT_KEEP:
+                break
+            del self._snapshots[r]
+
+    def _rollback(self, target_round: int) -> bool:
+        snap = self._snapshots.get(target_round)
+        if snap is None:
+            # Deeper than the in-memory ring (e.g. this client ALSO cold-
+            # restarted and only seeded its newest cut): the on-disk state
+            # generations under state_dir may still hold the target round.
+            # They carry no params — the coordinator's pre-round broadcast
+            # re-bases the weights in every recovery flow.
+            if self._state_ckpt is None:
+                return False
+            try:
+                tree = self._state_ckpt.restore(
+                    target_round, self._client_state()
+                )
+            except (ValueError, OSError):
+                return False
+            self._install_client_state(tree)
+            for r in [r for r in self._snapshots if r > target_round]:
+                del self._snapshots[r]
+            return True
+        self.round_idx = target_round
+        self.params = jax.tree.map(jnp.asarray, snap["params"])
+        self.batch_stats = jax.tree.map(jnp.asarray, snap["batch_stats"])
+        self.rng = jnp.asarray(snap["rng"])
+        self.opt_state = jax.tree.map(jnp.asarray, snap["opt_state"])
+        self.edge_residual = (
+            jax.tree.map(np.asarray, snap["residual"])
+            if snap["residual"] is not None else None
+        )
+        # Everything after the restored cut is now an alternate history.
+        for r in [r for r in self._snapshots if r > target_round]:
+            del self._snapshots[r]
+        return True
+
     def train_round(self, rank: int, world: int,
-                    trace_ctx: Optional[propagate.TraceContext] = None) -> bytes:
+                    trace_ctx: Optional[propagate.TraceContext] = None,
+                    coord_round: int = -1) -> bytes:
         """One local epoch on this client's shard; returns the wire payload
         (trained weights + stats + example count). ``trace_ctx`` — the
         coordinator's propagated trace context, when the StartTrain carried
         one: the span below then records the federation ``trace_id`` plus
         ``remote_parent``/``remote_role`` so ``tools/trace_merge.py`` can
         nest this client's work under the coordinator's round span, and the
-        tracer adopts the federation trace id."""
+        tracer adopts the federation trace id. ``coord_round`` — the
+        coordinator's lineage round from the TrainRequest (-1 from older
+        peers): a value BEHIND this client's local counter means the
+        coordinator recovered from a checkpoint older than the rounds this
+        client already trained, and the local state rolls back to match
+        (see _train_round_impl)."""
         tel = self.telemetry
         propagate.adopt(tel.tracer, trace_ctx)
         with tel.span("client_train", rank=rank, round=self.round_idx,
                       **propagate.span_args(trace_ctx)):
-            payload = self._train_round_impl(rank, world)
+            payload = self._train_round_impl(rank, world, coord_round)
+        self._persist_client_state()
         tel.counter(
             "fedtpu_client_tx_bytes_total",
             "StartTrain reply payload bytes shipped by this client",
@@ -201,8 +357,34 @@ class LocalTrainer:
         ).set(len(payload) / max(self._dense_bytes, 1))
         return payload
 
-    def _train_round_impl(self, rank: int, world: int) -> bytes:
+    def _train_round_impl(self, rank: int, world: int,
+                          coord_round: int = -1) -> bytes:
         cfg = self.cfg
+        # Coordinator-replay rollback (disaster recovery): a StartTrain
+        # whose lineage round is BEHIND our local counter means the
+        # coordinator cold-restarted from a checkpoint generation older
+        # than the rounds we already trained (its fallback past corrupt
+        # generations rewound the lineage). Training "forward" from our
+        # newer local state would silently fork the trajectory — instead
+        # rewind to the round-start snapshot of the replayed round, so the
+        # re-run reproduces the original round bit-for-bit. A coordinator
+        # AHEAD of us (participation sampling, stragglers) is ordinary
+        # drift and keeps the existing semantics.
+        if 0 <= coord_round < self.round_idx:
+            local_was = self.round_idx
+            if self._rollback(coord_round):
+                log.warning(
+                    "coordinator replays round %d (local counter was %d): "
+                    "rolled local state back to the matching snapshot",
+                    coord_round, local_was,
+                )
+            else:
+                log.warning(
+                    "coordinator replays round %d but no local snapshot "
+                    "survives (local counter %d); training forward — "
+                    "trajectories may diverge", coord_round, self.round_idx,
+                )
+        self._snapshot_round(self.round_idx)
         # Model-level attack consult (fedtpu.ft.chaos ATTACK_KINDS): one
         # decision per training round, keyed on this client's identity and
         # local round. label_flip poisons THIS round's training labels;
@@ -339,13 +521,16 @@ class ClientAgent(TrainerServicer):
     ``src/client.py:15-35``). StartTrain trains and returns weights; SendModel
     installs the global model and evaluates it; HeartBeat answers liveness."""
 
-    def __init__(self, cfg: RoundConfig, seed: int = 0):
-        self.trainer = LocalTrainer(cfg, seed=seed)
+    def __init__(self, cfg: RoundConfig, seed: int = 0,
+                 state_dir: Optional[str] = None):
+        self.trainer = LocalTrainer(cfg, seed=seed, state_dir=state_dir)
         self.last_eval: Optional[Tuple[float, float]] = None
 
     def StartTrain(self, request: proto.TrainRequest, context) -> proto.TrainReply:
         payload = self.trainer.train_round(
-            request.rank, request.world, trace_ctx=trace_context_of(context)
+            request.rank, request.world,
+            trace_ctx=trace_context_of(context),
+            coord_round=request.round,
         )
         return proto.TrainReply(message=payload)
 
@@ -377,14 +562,16 @@ class ClientAgent(TrainerServicer):
 
 def serve_client(
     address: str, cfg: RoundConfig, seed: int = 0, compress: bool = False,
-    chaos=None,
+    chaos=None, state_dir: Optional[str] = None,
 ):
     """Build + start a client agent server on ``address`` (parity:
     ``serve``, ``src/client.py:38-52``). Returns (server, agent).
     ``chaos`` (a :class:`fedtpu.ft.chaos.FaultSchedule`) arms fault
     injection on this agent's INBOUND RPCs — the client-side half of a
-    chaos drill."""
-    agent = ClientAgent(cfg, seed=seed)
+    chaos drill. ``state_dir`` persists the client's local training state
+    per round so a restarted agent resumes instead of silently diverging
+    (``--state-dir`` on the client CLI; docs/OPERATIONS.md)."""
+    agent = ClientAgent(cfg, seed=seed, state_dir=state_dir)
     # The bind address doubles as the client's trace/flight identity.
     agent.trainer.telemetry.role = f"client:{address}"
     agent.trainer.identity = address
@@ -825,6 +1012,74 @@ class PrimaryServer:
         self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
         if "membership" in tree:
             self._adopt_membership(tree["membership"])
+
+    def restore_from_checkpoint(self, ckpt) -> Optional[int]:
+        """Cold-start recovery protocol, coordinator side
+        (docs/OPERATIONS.md §Disaster recovery): restore the full server
+        state — model, monotone lineage counter, membership roster
+        including suspicion/reputation, FedOpt moments — from the newest
+        VERIFIED on-disk generation (``ckpt`` is a
+        :class:`fedtpu.checkpoint.Checkpointer` or the background wrapper;
+        its ``restore_latest`` falls back past corrupt generations and
+        counts ``fedtpu_checkpoint_fallback_total``). Adopting the
+        membership leaf re-resolves the roster and rebuilds the stub table
+        (``_adopt_membership``), and the initial-sync flag is cleared so
+        the first round after recovery pushes the restored global to every
+        surviving client through the existing ``sync_clients``/seat-resync
+        path — no client re-registers, nothing is lost from the roster.
+
+        Template ladder: current layout -> pre-elastic-membership layout
+        (startup roster kept) -> legacy model-only checkpoints (counter
+        estimated from the generation index). Returns the next round index
+        to run (``start_round``), or None for an empty directory (fresh
+        start). Raises :class:`wire.WireError` when generations exist but
+        none verifies — a disaster the operator must see, never a silent
+        restart from round 0."""
+        try:
+            latest = ckpt.restore_latest(self.state_template())
+        except wire.WireError:
+            raise
+        except ValueError:
+            try:
+                latest = ckpt.restore_latest(
+                    self.state_template(membership=False)
+                )
+            except wire.WireError:
+                raise
+            except ValueError:
+                latest = None
+        if latest is None:
+            params, stats = _model_template(self.model, self.cfg)
+            legacy = ckpt.restore_latest(
+                {"params": params, "batch_stats": stats}
+            )
+            if legacy is None:
+                return None
+            r, tree = legacy
+            self.params = jax.tree.map(jnp.asarray, tree["params"])
+            self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+            self._round_counter = r + 1
+            self._did_initial_sync = False
+            log.info("resumed legacy model-only checkpoint from round %d", r)
+            return r + 1
+        r, tree = latest
+        self.install_state(tree)
+        # Survivors hold weights from rounds the restored lineage may not
+        # know about; the pre-round broadcast re-bases everyone on the
+        # restored global (and the lineage round in their next StartTrain
+        # tells them to roll back local state to match).
+        self._did_initial_sync = False
+        log.info(
+            "cold start: restored round %d from %s (lineage continues at "
+            "%d; roster size %d, membership v%d)",
+            r, getattr(ckpt, "directory", "?"), self._round_counter,
+            self.registry.size, self.registry.version,
+        )
+        self.flight.record(
+            "checkpoint", event="restore", round=r,
+            members=self.registry.size,
+        )
+        return r + 1
 
     def replica_bytes(self) -> bytes:
         """Backup-replication payload: the model plus (when a server
@@ -1283,6 +1538,11 @@ class PrimaryServer:
     def _round_body(self, rspan) -> dict:
         cfg = self.cfg
         tel = self.telemetry
+        # Captured ONCE for the whole round: collect workers (including a
+        # straggler's late retry after the counter advanced) must all
+        # advertise the same lineage round in their TrainRequests — it is
+        # the client-side replay-detection signal of disaster recovery.
+        lineage_round = self._round_counter
         self.status.update(round=self._round_counter, phase="collect")
         if self.chaos is not None:
             # Advertise the lineage round so rounds= fault windows key on it.
@@ -1395,7 +1655,9 @@ class PrimaryServer:
                 # round" (the pre-policy behavior: the worker thread died
                 # with the exception and the reply just vanished).
                 reply = stub.StartTrain(
-                    proto.TrainRequest(rank=rank, world=world),
+                    proto.TrainRequest(
+                        rank=rank, world=world, round=lineage_round
+                    ),
                     timeout=self._deadlines["StartTrain"],
                 )
                 data = reply.message

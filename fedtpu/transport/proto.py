@@ -126,14 +126,33 @@ def _int32(value: int) -> int:
 class TrainRequest:
     rank: int = 0
     world: int = 0
+    # Additive field 3 (beyond the reference schema's two): the
+    # coordinator's LINEAGE round for this StartTrain, or -1 when unknown
+    # (older peers, async workers). Carried so a client can detect a
+    # coordinator REPLAY after disaster recovery (the resumed round is
+    # behind the client's local counter) and roll its local state back to
+    # the matching per-round snapshot instead of silently training a
+    # diverged round (docs/OPERATIONS.md §Disaster recovery). Encoded as
+    # round+1 so proto3's omit-zero default reads back as "absent" (-1),
+    # never as round -1 colliding with a real round 0; stock
+    # ``federated_pb2`` peers skip the unknown field.
+    round: int = -1
 
     def encode(self) -> bytes:
-        return _encode_fields([(1, _VARINT, self.rank), (2, _VARINT, self.world)])
+        return _encode_fields([
+            (1, _VARINT, self.rank),
+            (2, _VARINT, self.world),
+            (3, _VARINT, self.round + 1),
+        ])
 
     @classmethod
     def decode(cls, data: bytes) -> "TrainRequest":
         f = _decode_fields(data)
-        return cls(rank=_int32(f.get(1, 0)), world=_int32(f.get(2, 0)))
+        return cls(
+            rank=_int32(f.get(1, 0)),
+            world=_int32(f.get(2, 0)),
+            round=_int32(f.get(3, 0)) - 1,
+        )
 
 
 @dataclasses.dataclass

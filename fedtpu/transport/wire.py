@@ -136,6 +136,18 @@ def decode(data: bytes, like: Pytree) -> Pytree:
     return serialization.from_bytes(like, payload)
 
 
+def decode_raw(data: bytes) -> Pytree:
+    """Decode a framed payload WITHOUT a template: raw msgpack restore to
+    nested dicts of numpy arrays. For tools that inspect a payload whose
+    config they do not hold — e.g. fingerprinting the final checkpoint of
+    a disaster drill against its control run (``tools/chaos_soak.py
+    --disaster``). Framing (magic, version, CRC) is still validated."""
+    flags, payload = unframe(_MAGIC, data)
+    if flags & _FLAG_ZLIB:
+        payload = zlib.decompress(payload)
+    return serialization.msgpack_restore(payload)
+
+
 def decode_into_row(
     data: bytes, like: Pytree, base: Pytree, out: "np.ndarray"
 ) -> dict:

@@ -103,6 +103,36 @@ def test_json_parse_and_errors():
             parse_spec(bad)
 
 
+def test_disk_kinds_parse_and_stay_in_their_class():
+    """ckpt_fail/ckpt_torn/ckpt_rot (the durability fault class): bare
+    specs normalize to the pseudo-RPC 'Disk', wildcard wire rules never
+    fire on the Disk consult and disk rules never fire on wire RPCs —
+    kind classes never cross, same contract as the Attack class."""
+    sched = parse_spec(
+        "ckpt_rot:p=1.0,rounds=4,max=1;"
+        "ckpt_torn@Disk:p=1.0,rounds=5;"
+        "ckpt_fail:p=0.5"
+    )
+    assert [r.kind for r in sched.rules] == [
+        "ckpt_rot", "ckpt_torn", "ckpt_fail",
+    ]
+    assert all(r.rpc == "Disk" for r in sched.rules)
+    assert sched.rules[0].rounds == (4, 5)
+    # A wildcard WIRE rule must not fire on the Disk consult, and a disk
+    # rule must not fire on a wire RPC.
+    wire_sched = parse_spec("error@*:p=1.0")
+    assert wire_sched.decide("Disk") is None
+    disk_sched = parse_spec("ckpt_fail:p=1.0")
+    assert disk_sched.decide("StartTrain", "peer") is None
+    assert disk_sched.decide("Disk") is not None
+    # Class-crossing specs are parse errors, not silent no-ops.
+    for bad in ("ckpt_rot@StartTrain:p=1", "error@Disk:p=1",
+                "kill@Attack:p=1"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+    assert "ckpt_rot@Disk" in sched.describe()
+
+
 # ----------------------------------------------------- schedule semantics
 def test_schedule_is_deterministic_and_seed_sensitive():
     def draws(seed):

@@ -64,10 +64,18 @@ def test_retention_keeps_newest(tmp_path):
     ckpt = Checkpointer(str(tmp_path), keep=2, backend="wire")
     for r in range(5):
         ckpt.save(r, state)
+    files = os.listdir(tmp_path)
     kept = sorted(
-        int(f.split("_")[1].split(".")[0]) for f in os.listdir(tmp_path)
+        int(f.split("_")[1].split(".")[0])
+        for f in files if f.endswith(".fckpt")
     )
     assert kept == [3, 4]
+    # Each surviving generation carries its digest manifest; pruned
+    # generations lose theirs too.
+    manifests = sorted(f for f in files if f.endswith(".manifest.json"))
+    assert manifests == [
+        "round_3.fckpt.manifest.json", "round_4.fckpt.manifest.json"
+    ]
     assert latest_round(str(tmp_path)) == 4
 
 
@@ -102,6 +110,260 @@ def test_restore_latest_resumes_trajectory(tmp_path):
 def test_restore_latest_empty_dir(tmp_path):
     ckpt = Checkpointer(str(tmp_path / "nope"))
     assert ckpt.restore_latest(like={}) is None
+
+
+# ----------------------------------------------------- durability hardening
+def _corrupt_file(path, offset_from_end=3):
+    data = bytearray(open(path, "rb").read())
+    data[-offset_from_end] ^= 0x55
+    open(path, "wb").write(bytes(data))
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(tmp_path):
+    """Regression for the pre-hardening crash: a CRC-bad newest generation
+    raised straight through --resume instead of falling back. Now it is a
+    counted fallback event and the previous generation restores."""
+    from fedtpu.obs import MetricsRegistry
+
+    _, _, state = small_state()
+    reg = MetricsRegistry()
+    ckpt = Checkpointer(str(tmp_path), keep=3, backend="wire", metrics=reg)
+    for r in range(3):
+        ckpt.save(r, state)
+    _corrupt_file(str(tmp_path / "round_2.fckpt"))
+    r, restored = ckpt.restore_latest(like=state)
+    assert r == 1
+    _assert_tree_equal(state, restored)
+    assert reg.counter(
+        "fedtpu_checkpoint_fallback_total", ""
+    ).value == 1
+
+
+def test_restore_latest_falls_back_past_torn_write(tmp_path):
+    """A truncated (torn) newest generation — the manifest still claims
+    the full byte count — falls back the same way."""
+    _, _, state = small_state()
+    ckpt = Checkpointer(str(tmp_path), keep=3, backend="wire")
+    ckpt.save(0, state)
+    ckpt.save(1, state)
+    path = str(tmp_path / "round_1.fckpt")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
+    r, restored = ckpt.restore_latest(like=state)
+    assert r == 0
+    _assert_tree_equal(state, restored)
+
+
+def test_restore_latest_all_corrupt_raises_loudly(tmp_path):
+    """When generations exist but NONE verifies, resume must fail loudly —
+    silently restarting from round 0 would erase the run's history."""
+    from fedtpu.transport.wire import WireError
+
+    _, _, state = small_state()
+    ckpt = Checkpointer(str(tmp_path), keep=3, backend="wire")
+    ckpt.save(0, state)
+    ckpt.save(1, state)
+    for r in range(2):
+        _corrupt_file(str(tmp_path / f"round_{r}.fckpt"))
+    with pytest.raises(WireError, match="all 2 checkpoint generations"):
+        ckpt.restore_latest(like=state)
+
+
+def test_resume_requires_two_generations_retained(tmp_path):
+    """keep=1 cannot support generation fallback; resuming under it is a
+    config error, not a latent single-point-of-failure."""
+    _, _, state = small_state()
+    ckpt = Checkpointer(str(tmp_path), keep=1, backend="wire")
+    ckpt.save(0, state)
+    with pytest.raises(ValueError, match="keep >= 2"):
+        ckpt.restore_latest(like=state)
+    # Unbounded retention (keep <= 0) is fine — there is always history.
+    assert Checkpointer(
+        str(tmp_path), keep=0, backend="wire"
+    ).restore_latest(like=state)[0] == 0
+
+
+def test_template_mismatch_still_raises_not_falls_back(tmp_path):
+    """Corruption falls back; a CONFIG mismatch (intact bytes, wrong
+    structure) must raise — restoring an older generation would mask it."""
+    _, _, state = small_state()
+    ckpt = Checkpointer(str(tmp_path), keep=3, backend="wire")
+    ckpt.save(0, state)
+    ckpt.save(1, state)
+    with pytest.raises(ValueError):
+        ckpt.restore_latest(like={"different": np.zeros((3,), np.float32)})
+
+
+def test_save_failure_is_nonfatal_and_counted(tmp_path):
+    """An injected ENOSPC (chaos ckpt_fail) is a counted warning, not a
+    crash: save returns None, training would continue, and the NEXT save
+    (fault budget spent) succeeds. Old generations survive a failed save
+    (prune-only-after-verified-save)."""
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import MetricsRegistry
+
+    _, _, state = small_state()
+    reg = MetricsRegistry()
+    chaos = parse_spec("ckpt_fail:p=1.0,rounds=1,max=1")
+    ckpt = Checkpointer(
+        str(tmp_path), keep=2, backend="wire", metrics=reg, chaos=chaos,
+    )
+    chaos.set_round(0)
+    assert ckpt.save(0, state) is not None
+    chaos.set_round(1)
+    assert ckpt.save(1, state) is None  # injected ENOSPC
+    assert reg.counter(
+        "fedtpu_checkpoint_save_failures_total", ""
+    ).value == 1
+    assert latest_round(str(tmp_path)) == 0  # generation 0 untouched
+    chaos.set_round(2)
+    assert ckpt.save(2, state) is not None  # out of window; back to normal
+    assert ckpt.restore_latest(like=state)[0] == 2
+    # Strict mode keeps the old raise-on-failure contract.
+    strict = Checkpointer(
+        str(tmp_path), keep=2, backend="wire", strict=True,
+        chaos=parse_spec("ckpt_fail:p=1.0,max=1"),
+    )
+    with pytest.raises(OSError):
+        strict.save(3, state)
+
+
+def test_disk_chaos_rot_and_torn_are_silent_until_restore(tmp_path):
+    """ckpt_rot / ckpt_torn model a disk that ACKED the write and lost
+    bits later: the save reports success (metrics count it as a save, not
+    a failure), and only restore-time verification notices."""
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import MetricsRegistry
+
+    _, _, state = small_state()
+    reg = MetricsRegistry()
+    chaos = parse_spec("ckpt_rot:p=1.0,rounds=1,max=1")
+    ckpt = Checkpointer(
+        str(tmp_path), keep=3, backend="wire", metrics=reg, chaos=chaos,
+    )
+    chaos.set_round(0)
+    ckpt.save(0, state)
+    chaos.set_round(1)
+    assert ckpt.save(1, state) is not None  # "successful" — then rotted
+    assert reg.counter(
+        "fedtpu_checkpoint_save_failures_total", ""
+    ).value == 0
+    r, _restored = ckpt.restore_latest(like=state)
+    assert r == 0
+    assert reg.counter("fedtpu_checkpoint_fallback_total", "").value == 1
+
+
+def test_legacy_decode_suffix_drop_ladder(tmp_path):
+    """Each partial-generation blob restores with fresh-init backfill:
+    (a) missing only ``last_client_loss`` (mid-generation writer), and
+    (b) missing both ``server_opt_state`` and ``last_client_loss`` (first
+    release). Decoded fields keep the blob's values; dropped fields come
+    from ``like`` — its freshly initialised values."""
+    from fedtpu.checkpoint.checkpoint import _wire_path
+    from fedtpu.transport import wire as wire_mod
+
+    _, _, state = small_state()
+    # A recognisably different "saved" state: params/momenta bumped, so we
+    # can tell decoded fields from backfilled ones.
+    saved = state._replace(
+        params=jax.tree.map(lambda l: l + 1.0, state.params),
+        round_idx=state.round_idx + 7,
+    )
+    full = dict(saved._asdict())
+
+    def write_blob(round_idx, drop):
+        d = {k: v for k, v in full.items() if k not in drop}
+        os.makedirs(tmp_path, exist_ok=True)
+        with open(_wire_path(str(tmp_path), round_idx), "wb") as fh:
+            fh.write(wire_mod.encode(d, compress=True))
+
+    write_blob(0, drop=("last_client_loss",))
+    write_blob(1, drop=("server_opt_state", "last_client_loss"))
+
+    mid = restore(str(tmp_path), 0, like=state, backend="wire")
+    _assert_tree_equal(mid.params, saved.params)           # decoded
+    assert int(mid.round_idx) == int(saved.round_idx)      # decoded
+    _assert_tree_equal(mid.server_opt_state, saved.server_opt_state)
+    _assert_tree_equal(mid.last_client_loss, state.last_client_loss)  # backfilled
+
+    oldest = restore(str(tmp_path), 1, like=state, backend="wire")
+    _assert_tree_equal(oldest.params, saved.params)        # decoded
+    _assert_tree_equal(oldest.server_opt_state, state.server_opt_state)
+    _assert_tree_equal(oldest.last_client_loss, state.last_client_loss)
+
+
+def test_background_writer_orders_flushes_and_survives_errors(tmp_path):
+    """BackgroundCheckpointer: saves land in submission order, flush()
+    drains, a failing save never kills the writer thread, and the handed-
+    off trees are HOST arrays (the round loop's device buffers are
+    released at snapshot time)."""
+    from fedtpu.checkpoint import BackgroundCheckpointer
+    from fedtpu.ft.chaos import parse_spec
+
+    _, _, state = small_state()
+    dev_state = jax.tree.map(jnp.asarray, state)
+    # First save hits an injected ENOSPC (no rounds window: the writer
+    # thread decides asynchronously, so windows keyed on set_round would
+    # race); the remaining three land.
+    chaos = parse_spec("ckpt_fail:p=1.0,max=1")
+    inner = Checkpointer(
+        str(tmp_path), keep=10, backend="wire", chaos=chaos,
+    )
+    bg = BackgroundCheckpointer(inner, queue_depth=2)
+    seen = []
+    real_save = inner.save
+
+    def spy(round_idx, tree):
+        seen.append((round_idx,
+                     all(isinstance(l, np.ndarray)
+                         for l in jax.tree.leaves(tree))))
+        return real_save(round_idx, tree)
+
+    inner.save = spy
+    for r in range(4):
+        bg.save(r, dev_state)
+    assert bg.flush(timeout=30)
+    assert [r for r, _ in seen] == [0, 1, 2, 3]  # submission order
+    assert all(hosted for _, hosted in seen)     # host arrays only
+    # Save 0 failed non-fatally; the writer survived and the others are
+    # all durable and restorable.
+    from fedtpu.checkpoint.checkpoint import _scan_rounds
+
+    assert _scan_rounds(str(tmp_path)) == [1, 2, 3]
+    r, restored = bg.restore_latest(like=state)
+    assert r == 3
+    _assert_tree_equal(state, restored)
+    bg.close()
+    bg.close()  # idempotent
+
+
+def test_background_writer_snapshot_survives_buffer_donation(tmp_path):
+    """The writer's snapshot must be a forced COPY: the engines' round
+    steps donate their state buffers, and a zero-copy np view of a CPU
+    jax array would observe the next round's bytes by write time. Donate
+    the saved arrays immediately after save(); the written generation
+    must still hold the pre-donation values."""
+    from fedtpu.checkpoint import BackgroundCheckpointer
+
+    state = {
+        "a": jnp.arange(4096, dtype=jnp.float32),
+        "b": jnp.ones((128,), jnp.float32),
+    }
+    expected = jax.tree.map(np.array, state)
+    bump = jax.jit(
+        lambda t: jax.tree.map(lambda l: l + 1.0, t), donate_argnums=0
+    )
+    bg = BackgroundCheckpointer(
+        Checkpointer(str(tmp_path), keep=3, backend="wire")
+    )
+    bg.save(0, state)
+    state = bump(state)  # donates the saved buffers
+    jax.block_until_ready(state)
+    assert bg.flush(timeout=30)
+    restored = bg.restore(0, like=expected)
+    _assert_tree_equal(expected, restored)
+    bg.close()
 
 
 def test_mesh_checkpoint_resume_matches_uninterrupted(tmp_path, eight_devices):

@@ -37,9 +37,15 @@ def test_fused_cadences_write_expected_evals_and_checkpoints(tmp_path):
     eval_rounds = [r["step"] for r in rows if "test_acc" in r]
     assert eval_rounds == [7, 9], eval_rounds
     # checkpoint-every=4 crossings at block boundaries 4 and 8, plus the
-    # final-round save at 10.
-    assert sorted(os.listdir(ckpt)) == [
+    # final-round save at 10. Each generation carries its digest manifest
+    # (the hardened store's verify-on-read sidecar).
+    files = sorted(os.listdir(ckpt))
+    assert [f for f in files if f.endswith(".fckpt")] == [
         "round_10.fckpt", "round_4.fckpt", "round_8.fckpt"
+    ]
+    assert [f for f in files if f.endswith(".manifest.json")] == [
+        "round_10.fckpt.manifest.json", "round_4.fckpt.manifest.json",
+        "round_8.fckpt.manifest.json",
     ]
 
 

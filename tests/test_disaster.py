@@ -1,0 +1,333 @@
+"""Disaster recovery: cold-start restore, generation fallback, client
+rollback/state persistence — the tier-1 leg of the durability PR.
+
+The fast drill here is the in-process twin of ``tools/chaos_soak.py
+--disaster`` (which runs real subprocess SIGKILLs as a ``slow`` soak): a
+primary checkpoints every round through the hardened store while a seeded
+``ckpt_rot`` disk fault silently corrupts the newest generation; the
+primary object is then abandoned mid-lineage (total coordinator loss — no
+graceful handoff, no replica), a FRESH primary cold-starts from the
+directory, falls back a generation, resyncs the surviving stateful
+clients, and — because the lineage round carried in StartTrain makes the
+clients roll back to their matching round snapshots — finishes with a
+final model BIT-IDENTICAL to an uninterrupted control run.
+"""
+
+import os
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu.checkpoint import Checkpointer
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RoundConfig,
+)
+from fedtpu.ft.chaos import parse_spec
+from fedtpu.obs import MetricsRegistry
+from fedtpu.transport import wire
+from fedtpu.transport.federation import LocalTrainer, PrimaryServer, serve_client
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def tiny_cfg(num_clients=2, rounds=6, **fed_kw) -> RoundConfig:
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=128,
+        ),
+        fed=FedConfig(num_clients=num_clients, num_rounds=rounds, **fed_kw),
+        steps_per_round=2,
+    )
+
+
+def _params_equal(a, b) -> bool:
+    ok = []
+    jax.tree.map(
+        lambda x, y: ok.append(
+            np.array_equal(np.asarray(x), np.asarray(y))
+        ),
+        a, b,
+    )
+    return all(ok)
+
+
+# ------------------------------------------------------------ the fast drill
+def test_cold_restart_with_generation_fallback_matches_control(tmp_path):
+    """Total coordinator loss, corrupt newest generation, surviving
+    stateful clients: the recovered lineage must re-run the voided round
+    through client rollback and converge BIT-IDENTICALLY to a run that
+    never crashed. Also pins: fallback counted, restored FedOpt moments,
+    supersession-exact lineage, full participation post-recovery."""
+    n, rounds, crash_after = 2, 6, 5  # crash after round 4 committed
+    cfg = tiny_cfg(n, rounds, server_optimizer="momentum")
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def run_control():
+        servers, addrs = [], []
+        try:
+            for i in range(n):
+                addr = f"localhost:{free_port()}"
+                server, _ = serve_client(addr, cfg, seed=i)
+                servers.append(server)
+                addrs.append(addr)
+            primary = PrimaryServer(cfg, addrs)
+            recs = [primary.round() for _ in range(rounds)]
+            return (
+                jax.tree.map(np.asarray, primary.params),
+                [int(r["round"]) for r in recs],
+            )
+        finally:
+            for s in servers:
+                s.stop(0)
+
+    control_params, control_lineage = run_control()
+    assert control_lineage == list(range(rounds))
+
+    servers, addrs = [], []
+    try:
+        for i in range(n):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            addrs.append(addr)
+        # Generation 4 (the newest at crash time) silently bit-rots after
+        # its verified write — the same schedule drives the primary's wire
+        # interceptors (where the disk rule is inert) so set_round flows.
+        chaos = parse_spec(
+            f"ckpt_rot:p=1.0,rounds={crash_after - 1},max=1"
+        )
+        reg1 = MetricsRegistry()
+        ckpt1 = Checkpointer(
+            ckpt_dir, keep=4, backend="wire", metrics=reg1, chaos=chaos,
+        )
+        primary1 = PrimaryServer(cfg, addrs, chaos=chaos)
+        gen1_lineage = []
+        for r in range(crash_after):
+            rec = primary1.round()
+            gen1_lineage.append(int(rec["round"]))
+            ckpt1.save(r, primary1.state_tree())
+        assert gen1_lineage == list(range(crash_after))
+        # CRASH: the primary object is abandoned — no graceful handoff,
+        # no replica; the disk is the only surviving copy.
+        del primary1
+
+        reg2 = MetricsRegistry()
+        ckpt2 = Checkpointer(ckpt_dir, keep=4, backend="wire", metrics=reg2)
+        primary2 = PrimaryServer(cfg, addrs)
+        start = primary2.restore_from_checkpoint(ckpt2)
+        # Newest (4) is rotten -> fallback to 3 -> resume at round 4.
+        assert start == crash_after - 1
+        assert reg2.counter(
+            "fedtpu_checkpoint_fallback_total", ""
+        ).value == 1
+        assert primary2._round_counter == start
+        gen2_lineage = []
+        for _ in range(rounds - start):
+            rec = primary2.round()
+            gen2_lineage.append(int(rec["round"]))
+            assert rec["participants"] == n  # survivors resynced, no loss
+        # Supersession: the crash voided the never-durable round 4; the
+        # durable history + the restart's records exact-cover 0..N-1.
+        durable = [r for r in gen1_lineage if r < start]
+        assert durable + gen2_lineage == list(range(rounds))
+        recovered_params = jax.tree.map(np.asarray, primary2.params)
+    finally:
+        for s in servers:
+            s.stop(0)
+
+    assert _params_equal(recovered_params, control_params), (
+        "recovered trajectory diverged from the uninterrupted control"
+    )
+
+
+def test_cold_restart_all_generations_corrupt_raises(tmp_path):
+    """A directory where nothing verifies must fail the resume loudly —
+    never silently restart the lineage from round 0."""
+    cfg = tiny_cfg(2, 2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    primary = PrimaryServer(cfg, [])
+    ckpt = Checkpointer(ckpt_dir, keep=3, backend="wire")
+    ckpt.save(0, primary.state_tree())
+    path = os.path.join(ckpt_dir, "round_0.fckpt")
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0x55
+    open(path, "wb").write(bytes(data))
+    fresh = PrimaryServer(cfg, [])
+    with pytest.raises(wire.WireError, match="checkpoint generations"):
+        fresh.restore_from_checkpoint(Checkpointer(ckpt_dir, backend="wire"))
+
+
+def test_membership_and_reputation_survive_cold_restart(tmp_path):
+    """Roster state restored from disk: a member admitted at runtime (the
+    Join path) and its suspicion score are both present after a cold
+    restart WITHOUT re-registration — the "no re-registration data loss"
+    half of the recovery protocol."""
+    cfg = tiny_cfg(2, 4)
+    ckpt_dir = str(tmp_path / "ckpt")
+    servers, addrs = [], []
+    try:
+        for i in range(3):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            addrs.append(addr)
+        static, joiner = addrs[:2], addrs[2]
+        primary1 = PrimaryServer(cfg, static)
+        out = primary1.admit_client(joiner)
+        assert out["admitted"] and out["resynced"]
+        version1 = primary1.registry.version
+        primary1.registry.observe_screening(joiner, True, ewma=0.5)
+        suspicion1 = primary1.registry.suspicion(joiner)
+        assert suspicion1 > 0
+        primary1.round()
+        ckpt = Checkpointer(ckpt_dir, keep=3, backend="wire")
+        ckpt.save(0, primary1.state_tree())
+        del primary1
+
+        primary2 = PrimaryServer(cfg, static)  # startup roster: 2 members
+        start = primary2.restore_from_checkpoint(
+            Checkpointer(ckpt_dir, backend="wire")
+        )
+        assert start == 1
+        assert primary2.registry.is_member(joiner)
+        assert primary2.registry.version == version1
+        assert primary2.registry.suspicion(joiner) == pytest.approx(
+            suspicion1
+        )
+        # The adopted roster is dialable: the next round reaches all 3.
+        rec = primary2.round()
+        assert rec["participants"] == 3
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+# ----------------------------------------------------- client-side durability
+def test_client_state_dir_restart_resumes_bit_identically(tmp_path):
+    """A RESTARTED client (fresh process semantics: new LocalTrainer, same
+    --state-dir) must produce the exact payload the uninterrupted client
+    would have: round counter, optimizer moments, PRNG stream, and the
+    error-feedback residual all restore from the per-round generational
+    store. Without state_dir the restart silently diverges (pinned too —
+    that is the failure the flag exists for)."""
+    cfg = tiny_cfg(1, 8, compression="topk", topk_fraction=0.05)
+    state_dir = str(tmp_path / "client_state")
+
+    def fresh(seed=0, state_dir_=None):
+        t = LocalTrainer(cfg, seed=seed, state_dir=state_dir_)
+        return t
+
+    # One fixed "global" install per round, standing in for the server's
+    # per-round broadcast (identical for every trainer instance: same
+    # seed -> same init).
+    proto_trainer = fresh()
+    global_payload = wire.encode(
+        {"params": proto_trainer.params,
+         "batch_stats": proto_trainer.batch_stats},
+    )
+
+    def run_rounds(trainer, k):
+        out = None
+        for _ in range(k):
+            trainer.set_global(global_payload)
+            out = trainer.train_round(0, 1)
+        return out
+
+    control = fresh()
+    control_payload = run_rounds(control, 3)
+
+    t1 = fresh(state_dir_=state_dir)
+    run_rounds(t1, 2)
+    assert t1.edge_residual is not None  # EF is live and persisted
+    del t1  # process death
+
+    t2 = fresh(state_dir_=state_dir)
+    assert t2.round_idx == 2  # resumed, not reset
+    assert t2.edge_residual is not None
+    resumed_payload = run_rounds(t2, 1)
+    assert resumed_payload == control_payload
+
+    # Counter-example: a stateless restart diverges (different round seed
+    # and a lost residual) — the hazard the flag closes.
+    t3 = fresh()
+    run_rounds(t3, 2)
+    t4 = fresh()  # restart WITHOUT state_dir
+    diverged_payload = run_rounds(t4, 1)
+    assert diverged_payload != control_payload
+
+
+def test_client_rollback_on_coordinator_replay():
+    """A StartTrain carrying a lineage round BEHIND the client's local
+    counter (coordinator recovered from an older generation) rolls the
+    client back to its round snapshot: the replayed round's payload is
+    byte-identical to the original. A request AHEAD of the counter keeps
+    the ordinary drift semantics (no rollback)."""
+    cfg = tiny_cfg(1, 8)
+    t = LocalTrainer(cfg, seed=0)
+    payloads = {}
+    for r in range(4):
+        payloads[r] = t.train_round(0, 1, coord_round=r)
+    assert t.round_idx == 4
+    # Replay round 2: rollback (snapshot ring holds rounds 0..3).
+    replay = t.train_round(0, 1, coord_round=2)
+    assert replay == payloads[2]
+    assert t.round_idx == 3  # counter follows the replayed lineage
+    # And the lineage continues forward identically.
+    assert t.train_round(0, 1, coord_round=3) == payloads[3]
+    # Ahead-of-counter (sampling skip): trains forward, no rollback.
+    before = t.round_idx
+    t.train_round(0, 1, coord_round=before + 5)
+    assert t.round_idx == before + 1
+
+
+def test_client_rollback_depth_is_ring_bounded():
+    """A replay deeper than SNAPSHOT_KEEP has no snapshot: the client
+    logs and trains forward (divergence is reported, not hidden)."""
+    cfg = tiny_cfg(1, 16)
+    t = LocalTrainer(cfg, seed=0)
+    for r in range(8):
+        t.train_round(0, 1, coord_round=r)
+    target = 8 - LocalTrainer.SNAPSHOT_KEEP - 1
+    assert not t._rollback(target)
+    t.train_round(0, 1, coord_round=target)  # no raise; forward training
+    assert t.round_idx == 9
+
+
+# ------------------------------------------------------- the full soak (slow)
+@pytest.mark.slow
+def test_disaster_soak_total_process_loss(tmp_path):
+    """The committed-artifact soak re-run: subprocess primary+backup
+    SIGKILLed mid-round under seeded torn+rot disk faults, cold restart,
+    supersession-exact lineage, bit-identical final model vs control.
+    Several minutes; marked slow."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ))
+    import chaos_soak
+
+    result = chaos_soak.run_disaster_soak(
+        rounds=16, kill_round=8, workdir=str(tmp_path / "soak"),
+        verbose=False,
+    )
+    assert result["ok"] is True
+    assert result["checkpoint_fallbacks"] == 2
+    assert result["bit_identical_vs_control"] is True
+    assert result["lineage"]["exact_cover"] is True

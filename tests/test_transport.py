@@ -23,6 +23,24 @@ def test_train_request_roundtrip():
         assert proto.TrainRequest.decode(msg.encode()) == msg
 
 
+def test_train_request_round_field():
+    """The additive lineage-round field (disaster recovery): encodes as
+    round+1 so "unknown" (-1) is the proto3 omitted default — bytes from
+    peers that predate the field decode as round=-1, and a request with
+    round unset is byte-identical to a pre-field encoder's output."""
+    for rnd in (-1, 0, 1, 17, 2**20):
+        msg = proto.TrainRequest(rank=1, world=4, round=rnd)
+        assert proto.TrainRequest.decode(msg.encode()) == msg
+    # Unset round adds zero bytes: old decoders see exactly the old wire.
+    legacy = proto.TrainRequest(rank=3, world=8)
+    assert legacy.encode() == b"\x08\x03\x10\x08"  # no field-3 tag at all
+    assert proto.TrainRequest.decode(legacy.encode()).round == -1
+    # round=0 must survive (it is a real round, not the absent default).
+    assert proto.TrainRequest.decode(
+        proto.TrainRequest(round=0).encode()
+    ).round == 0
+
+
 def test_bytes_messages_roundtrip():
     payload = bytes(range(256)) * 100  # non-UTF8 on purpose
     for cls, field in [

@@ -8,7 +8,11 @@ rejoins, graceful Leave/rejoin cycles — plus one mid-soak rolling
 primary -> backup -> primary upgrade, verifying zero transient deaths, a
 strictly monotone lineage round counter, a bit-identical final model vs an
 unupgraded control run, and a FLAT memory profile from the ``/statusz``
-RSS gauge. Writes ``artifacts/CHURN_SOAK.json``.
+RSS gauge. Writes ``artifacts/CHURN_SOAK.json``. ``--disaster`` runs the
+TOTAL-PROCESS-LOSS drill (:func:`run_disaster_soak`): primary and backup
+SIGKILLed mid-round under seeded disk faults, cold restart from the
+hardened checkpoint store with generation fallback, bit-identical to a
+no-crash control — ``artifacts/DISASTER_SOAK.json``.
 
 What it proves (the acceptance spine of the chaos/resilience PR;
 docs/FAULT_TOLERANCE.md):
@@ -606,6 +610,337 @@ def run_byzantine_soak(
             s.stop(0)
 
 
+# ------------------------------------------------------------- disaster soak
+def _model_fingerprint_from_dir(ckpt_dir: str):
+    """(latest_round, sha256-of-model) from a checkpoint directory, read
+    WITHOUT a config template (wire.decode_raw): the fingerprint covers
+    the params + batch_stats leaves in deterministic key order, so two
+    runs with different ports/rosters still compare model-for-model."""
+    import hashlib
+
+    from fedtpu.checkpoint import latest_round
+    from fedtpu.transport import wire
+
+    r = latest_round(ckpt_dir)
+    assert r is not None, f"no checkpoint generations in {ckpt_dir}"
+    with open(os.path.join(ckpt_dir, f"round_{r}.fckpt"), "rb") as fh:
+        tree = wire.decode_raw(fh.read())
+    h = hashlib.sha256()
+
+    def fold(node):
+        if isinstance(node, dict):
+            for key in sorted(node):
+                h.update(str(key).encode())
+                fold(node[key])
+        else:
+            import numpy as np
+
+            arr = np.asarray(node)
+            h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+
+    fold({"params": tree["params"], "batch_stats": tree["batch_stats"]})
+    return r, h.hexdigest()
+
+
+def run_disaster_soak(
+    rounds: int = 24,
+    clients: int = 3,
+    kill_round: int = 12,
+    keep: int = 8,
+    seed: int = 7,
+    watchdog_s: float = 120.0,
+    workdir: str = "/tmp/fedtpu_disaster_soak",
+    verbose: bool = True,
+) -> dict:
+    """The total-process-loss drill (acceptance spine of the durability
+    PR; docs/OPERATIONS.md §Disaster recovery): primary AND backup are
+    SIGKILLed mid-round — every in-memory copy of the federation state is
+    gone — under seeded DISK faults that silently corrupted the two newest
+    checkpoint generations (``ckpt_torn`` on the save after round K-1,
+    ``ckpt_rot`` on the save after round K-2). A cold-restarted primary
+    (``--resume`` against the same ``--checkpoint-dir``) must then:
+
+    1. fall back past both corrupt generations to the newest VERIFIED one
+       (``fedtpu_checkpoint_fallback_total == 2``), resuming at round K-2
+       with ZERO manual intervention (no files deleted, no flags beyond
+       the ordinary restart command);
+    2. resync the surviving clients through the ordinary pre-round
+       broadcast — no re-registration (``fedtpu_membership_joins_total ==
+       0`` post-restart), full participation from the first recovered
+       round; the lineage round carried in StartTrain makes each client
+       roll its local state back to its round-K-2 snapshot, so the
+       replayed rounds retrain bit-for-bit;
+    3. produce a lineage that is exact-cover monotone across the restart
+       under SUPERSESSION semantics: the crash voided the never-durable
+       rounds >= K-2, the restart re-commits them, and the durable history
+       (pre-crash records below the resume point + the restart's records)
+       covers exactly 0..N-1;
+    4. end with a final model BIT-IDENTICAL to an uninterrupted control
+       run — the whole recovery, rollback included, is trajectory-neutral.
+
+    Topology: client agents in THIS process (they survive — the disaster
+    is coordinator-total, not world-total; a restarted CLIENT is covered
+    by --state-dir, tests/test_disaster.py), primary and backup as real
+    subprocesses so SIGKILL is a genuine process death. Writes
+    ``artifacts/DISASTER_SOAK.json`` via ``--disaster``.
+    """
+    from fedtpu.obs import parse_prometheus_text
+    from fedtpu.transport.federation import serve_client
+
+    assert 4 <= kill_round <= rounds - 2, (kill_round, rounds)
+    assert keep >= 4, "need headroom: two corrupt generations + fallback"
+    t_start = time.monotonic()
+
+    def note(msg):
+        if verbose:
+            print(f"[disaster] {msg}", flush=True)
+
+    os.makedirs(workdir, exist_ok=True)
+    for name in os.listdir(workdir):
+        path = os.path.join(workdir, name)
+        if os.path.isdir(path):
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            os.unlink(path)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    control_dir = os.path.join(workdir, "ckpt_control")
+
+    cfg = _tiny_cfg(clients, rounds)
+    # The save after round K-1 is TORN and the one after K-2 BIT-ROTTEN —
+    # both silently (the writer verified before the fault landed, exactly
+    # a disk that acked and then lost the bytes). The kill fires on the
+    # first StartTrain of round K. Newest verified generation: K-3, so
+    # recovery resumes at K-2 after two fallbacks.
+    spec = (
+        f"kill@StartTrain:p=1.0,rounds={kill_round}-{kill_round + 1},"
+        f"max=1,seed={seed};"
+        f"ckpt_torn@Disk:p=1.0,rounds={kill_round - 1}-{kill_round},max=1;"
+        f"ckpt_rot@Disk:p=1.0,rounds={kill_round - 2}-{kill_round - 1},max=1"
+    )
+    expected_resume = kill_round - 2
+    result: dict = {"config": {
+        "rounds": rounds, "clients": clients, "kill_round": kill_round,
+        "keep": keep, "seed": seed, "chaos_spec": spec,
+        "expected_resume_round": expected_resume,
+    }}
+
+    def launch_backup(gen: int, addrs, port: int):
+        cmd = [
+            sys.executable, "-m", "fedtpu.cli.server",
+            "--platform", "cpu",
+            "--model", "mlp", "--dataset", "synthetic",
+            "--num-examples", "256", "--batch-size", "8",
+            "--eval-batch-size", "8",
+            "--clients", ",".join(addrs),
+            "--listen", f"localhost:{port}",
+            "--watchdog-timeout", str(watchdog_s),
+            "--seed", "0",
+        ]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def launch_primary(tag: str, addrs, backup_port, directory,
+                       chaos_spec=None, resume=False, sync_writes=False):
+        metrics = os.path.join(workdir, f"primary_{tag}.jsonl")
+        prom = os.path.join(workdir, f"primary_{tag}.prom")
+        cmd = [
+            sys.executable, "-m", "fedtpu.cli.server",
+            "--p", "y", "--platform", "cpu",
+            "--model", "mlp", "--dataset", "synthetic",
+            "--num-examples", "256", "--batch-size", "8",
+            "--eval-batch-size", "8",
+            "--rounds", str(rounds),
+            "--clients", ",".join(addrs),
+            "--checkpoint-dir", directory,
+            "--checkpoint-keep", str(keep),
+            "--metrics", metrics, "--prom-out", prom,
+            "--seed", "0",
+        ]
+        if backup_port is not None:
+            cmd += ["--backupAddress", "localhost",
+                    "--backupPort", str(backup_port)]
+        if chaos_spec:
+            cmd += ["--chaos-spec", chaos_spec]
+        if resume:
+            cmd += ["--resume"]
+        if sync_writes:
+            # Deterministic disk-fault placement: synchronous saves pin
+            # each save's chaos round window to the round it snapshots
+            # (the background writer races the next round's set_round).
+            cmd += ["--checkpoint-sync"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return proc, metrics, prom
+
+    # ------------------------------------------------------- disaster run
+    servers, agents, addrs = [], [], []
+    procs = []
+    try:
+        for i in range(clients):
+            addr = f"localhost:{free_port()}"
+            server, agent = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            agents.append(agent)
+            addrs.append(addr)
+        bport1 = free_port()
+        backup1 = launch_backup(1, addrs, bport1)
+        procs.append(backup1)
+        note(f"gen 1: {rounds} rounds, kill at round {kill_round}, "
+             f"torn ckpt at {kill_round - 1}, rot at {kill_round - 2}")
+        p1, metrics1, _prom1 = launch_primary(
+            "gen1", addrs, bport1, ckpt_dir, chaos_spec=spec,
+            sync_writes=True,
+        )
+        procs.append(p1)
+        deadline = time.monotonic() + 600
+        while p1.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert p1.poll() is not None, "gen 1 never exited (kill never fired)"
+        result["gen1_rc"] = p1.returncode
+        assert p1.returncode != 0, (
+            "gen 1 exited cleanly — the kill rule never fired"
+        )
+        # The disaster is TOTAL: the backup's in-memory replica dies too,
+        # seconds after the primary (before its watchdog could promote).
+        backup1.kill()
+        backup1.wait(timeout=30)
+        note("primary and backup SIGKILLed; every in-memory copy is gone")
+        recs1 = _read_records(metrics1)
+        committed1 = [r for r in recs1 if not r.get("aborted")]
+        result["gen1_committed"] = len(committed1)
+        assert len(committed1) == kill_round, (
+            f"gen 1 committed {len(committed1)} rounds, wanted {kill_round}"
+        )
+
+        note("cold restart: fresh backup + primary --resume from the "
+             "(partially corrupted) checkpoint dir — no manual cleanup")
+        bport2 = free_port()
+        backup2 = launch_backup(2, addrs, bport2)
+        procs.append(backup2)
+        p2, metrics2, prom2 = launch_primary(
+            "gen2", addrs, bport2, ckpt_dir, resume=True,
+        )
+        procs.append(p2)
+        try:
+            p2.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+            raise AssertionError("recovered primary hung")
+        result["gen2_rc"] = p2.returncode
+        assert p2.returncode == 0, f"recovery failed rc={p2.returncode}"
+        backup2.kill()
+
+        recs2 = _read_records(metrics2)
+        committed2 = [r for r in recs2 if not r.get("aborted")]
+        assert committed2, "recovered primary committed nothing"
+        resume_round = int(committed2[0]["round"])
+        result["resume_round"] = resume_round
+        assert resume_round == expected_resume, (
+            f"resumed at {resume_round}, expected {expected_resume} "
+            "(two generation fallbacks)"
+        )
+        with open(prom2) as fh:
+            prom2_metrics = parse_prometheus_text(fh.read())
+        fallbacks = sum(
+            prom2_metrics.get("fedtpu_checkpoint_fallback_total", {}).values()
+        )
+        rejoins = sum(
+            prom2_metrics.get("fedtpu_membership_joins_total", {}).values()
+        )
+        result["checkpoint_fallbacks"] = fallbacks
+        result["post_restart_joins"] = rejoins
+        assert fallbacks == 2, (
+            f"{fallbacks} restore fallbacks, expected 2 (torn + rot)"
+        )
+        assert rejoins == 0, (
+            "surviving clients re-registered — roster was lost"
+        )
+
+        # Lineage under supersession: the crash voided the never-durable
+        # tail (>= resume_round); what remains plus the restart's records
+        # must cover exactly 0..N-1, strictly monotone.
+        durable1 = [
+            int(r["round"]) for r in committed1
+            if int(r["round"]) < resume_round
+        ]
+        lineage = durable1 + [int(r["round"]) for r in committed2]
+        result["lineage"] = {
+            "committed": len(lineage),
+            "superseded": len(committed1) - len(durable1),
+            "strictly_monotone": all(
+                b == a + 1 for a, b in zip(lineage, lineage[1:])
+            ),
+            "exact_cover": lineage == list(range(rounds)),
+        }
+        assert result["lineage"]["exact_cover"], result["lineage"]
+        # Full participation from the first recovered round: the
+        # survivors resynced through the ordinary broadcast.
+        assert all(r["participants"] == clients for r in committed2), (
+            "a surviving client missed a post-recovery round"
+        )
+        evals = []
+        for agent in agents:
+            assert agent.last_eval is not None, "client never evaluated"
+            loss, acc = agent.last_eval
+            assert loss == loss and abs(loss) != float("inf"), loss
+            evals.append({"loss": loss, "acc": acc})
+        result["final_evals"] = evals
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for s in servers:
+            s.stop(0)
+
+    # -------------------------------------------------------- control run
+    note("control run: same config, fresh clients, no crash, no faults")
+    servers2, addrs2 = [], []
+    try:
+        for i in range(clients):
+            addr = f"localhost:{free_port()}"
+            server, _agent = serve_client(addr, cfg, seed=i)
+            servers2.append(server)
+            addrs2.append(addr)
+        pc, metrics_c, _prom_c = launch_primary(
+            "control", addrs2, None, control_dir,
+        )
+        try:
+            pc.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            pc.kill()
+            raise AssertionError("control primary hung")
+        assert pc.returncode == 0, f"control failed rc={pc.returncode}"
+        recs_c = _read_records(metrics_c)
+        assert _committed(recs_c) == rounds
+    finally:
+        for s in servers2:
+            s.stop(0)
+
+    r_d, fp_d = _model_fingerprint_from_dir(ckpt_dir)
+    r_c, fp_c = _model_fingerprint_from_dir(control_dir)
+    result["final_round"] = {"disaster": r_d, "control": r_c}
+    result["model_fingerprint"] = {"disaster": fp_d, "control": fp_c}
+    result["bit_identical_vs_control"] = fp_d == fp_c
+    assert r_d == r_c == rounds - 1, (r_d, r_c)
+    assert result["bit_identical_vs_control"], (
+        "post-disaster final model differs from the uninterrupted "
+        "control — recovery was not trajectory-neutral"
+    )
+    result["manual_interventions"] = 0  # scripted restart only, by design
+    result["wall_s"] = round(time.monotonic() - t_start, 2)
+    result["ok"] = True
+    return result
+
+
 # ---------------------------------------------------------------- churn soak
 class GhostableAgent:
     """A ClientAgent whose reachability is a driver-controlled switch:
@@ -1158,6 +1493,19 @@ def main(argv=None) -> int:
     ap.add_argument("--byz-malicious", default=2, type=int)
     ap.add_argument("--byz-error-p", default=0.10, type=float)
     ap.add_argument(
+        "--disaster", action="store_true",
+        help="run the total-process-loss drill instead: primary AND "
+        "backup SIGKILLed mid-round under seeded ckpt_torn/ckpt_rot disk "
+        "faults -> cold restart from --checkpoint-dir falls back past the "
+        "corrupt generations, survivors resync without re-registration, "
+        "lineage exact-covers under supersession, final model bit-"
+        "identical to a no-crash control; writes "
+        "artifacts/DISASTER_SOAK.json",
+    )
+    ap.add_argument("--disaster-rounds", default=24, type=int)
+    ap.add_argument("--disaster-kill-round", default=12, type=int)
+    ap.add_argument("--disaster-keep", default=8, type=int)
+    ap.add_argument(
         "--churn", action="store_true",
         help="run the long-haul elastic-membership churn soak instead "
         "(continuous join/leave/rejoin + one mid-soak rolling upgrade; "
@@ -1172,6 +1520,24 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.disaster:
+        try:
+            result = run_disaster_soak(
+                rounds=args.disaster_rounds,
+                clients=args.clients,
+                kill_round=args.disaster_kill_round,
+                keep=args.disaster_keep,
+                seed=args.seed,
+            )
+        except AssertionError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}))
+            return 1
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "DISASTER_SOAK.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        print(json.dumps(result))
+        return 0
     if args.byzantine:
         try:
             result = run_byzantine_soak(

@@ -696,6 +696,79 @@ def add_obs_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_profile_flags(p: argparse.ArgumentParser) -> None:
+    """The performance observatory's capture/accounting controls
+    (fedtpu.obs.profile; docs/OBSERVABILITY.md 'Profiling')."""
+    p.add_argument(
+        "--profile-rounds",
+        default=None,
+        metavar="N[:M]",
+        help="capture a jax.profiler device trace covering rounds [N, M) "
+        "(half-open; bare N = that one round) into --profile-trace-dir. "
+        "The capture writes a wall-clock sidecar so tools/trace_merge.py "
+        "--device-trace aligns device ops with the host span timeline",
+    )
+    p.add_argument(
+        "--profile-trace-dir",
+        default="profile_trace",
+        metavar="DIR",
+        help="output directory for --profile-rounds captures",
+    )
+    p.add_argument(
+        "--mfu",
+        default="auto",
+        choices=["auto", "off", "analytic", "xla"],
+        help="per-round MFU/roofline accounting: fedtpu_mfu_ratio / "
+        "achieved-FLOPs/s / step-time gauges + round-record stamps. "
+        "'analytic' prices the program by walking its jaxpr (cheap); "
+        "'xla' additionally cross-checks against the compiled "
+        "executable's cost_analysis (one extra AOT compile at startup); "
+        "'auto' = analytic when --telemetry is on, else off",
+    )
+
+
+def resolve_mfu_mode(args) -> str:
+    """Collapse --mfu auto against --telemetry: the gauges land in the
+    telemetry registry, so accounting without a registry is pure cost."""
+    mode = getattr(args, "mfu", "off")
+    if mode == "auto":
+        return "analytic" if getattr(args, "telemetry", "off") != "off" else "off"
+    return mode
+
+
+def make_capture_window(args, role: str, telemetry=None):
+    """Honor --profile-rounds: an armed CaptureWindow, or None. The caller
+    drives it with maybe_start(round)/maybe_stop(round) and must stop() it
+    at exit (idempotent) so a window open past the last round still closes."""
+    spec = getattr(args, "profile_rounds", None)
+    if spec is None:
+        return None
+    from fedtpu.obs.profile import CaptureWindow
+
+    trace_id = None
+    if telemetry is not None and telemetry.tracer is not None:
+        trace_id = telemetry.tracer.trace_id
+    return CaptureWindow(
+        spec, getattr(args, "profile_trace_dir", "profile_trace"),
+        role=role, trace_id=trace_id,
+    )
+
+
+def install_compile_watcher(telemetry=None, flight=None):
+    """Arm the XLA compile observer for a CLI process. Best-effort: an
+    already-active watcher (tests driving main() in-process) or a jax
+    without the monitoring hook degrades to None, never to a crash."""
+    from fedtpu.obs.profile import CompileWatcher
+
+    try:
+        return CompileWatcher(telemetry=telemetry, flight=flight).install()
+    except Exception:
+        import logging
+
+        logging.debug("compile watcher unavailable", exc_info=True)
+        return None
+
+
 def start_obs_server(args, registry=None, status_fn=None, flight=None):
     """Honor --obs-port: start (and return) the endpoint, or None when the
     flag is absent. The caller owns stop()."""

@@ -20,15 +20,19 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_profile_flags,
     add_robustness_flags,
     add_sim_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
+    install_compile_watcher,
     install_final_flush,
+    make_capture_window,
     make_chaos,
     make_checkpointer,
     make_flight_recorder,
+    resolve_mfu_mode,
     start_obs_server,
 )
 from fedtpu.core import Federation
@@ -97,6 +101,7 @@ def main(argv=None) -> int:
     )
     add_telemetry_export_flags(p)
     add_obs_flags(p)
+    add_profile_flags(p)
     add_robustness_flags(p)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", default=10, type=int)
@@ -151,6 +156,18 @@ def main(argv=None) -> int:
     chaos = make_chaos(args, role="engine")
     logger = RoundRecordWriter(path=args.metrics, echo=not args.progress)
     flight = make_flight_recorder("engine", telemetry=fed.telemetry)
+    # Performance observatory (fedtpu.obs.profile): compile counting from
+    # the very first jit, MFU accounting when the registry is live, and the
+    # --profile-rounds device-trace window driven from the round loop.
+    compile_w = install_compile_watcher(
+        telemetry=fed.telemetry, flight=flight
+    )
+    if compile_w is not None:
+        fed.compile_watcher = compile_w
+    mfu_mode = resolve_mfu_mode(args)
+    if mfu_mode != "off" and hasattr(fed, "enable_mfu_accounting"):
+        fed.enable_mfu_accounting(xla_check=mfu_mode == "xla")
+    capture = make_capture_window(args, role="engine", telemetry=fed.telemetry)
     ckpt, start_round, state = _restore_from(
         args, like=fed.state, telemetry=fed.telemetry, flight=flight,
         chaos=chaos,
@@ -185,6 +202,10 @@ def main(argv=None) -> int:
             if chaos is not None:
                 chaos.tick_round(r)
             block = min(max(1, args.fused), cfg.fed.num_rounds - r)
+            if capture is not None:
+                # Fused blocks are captured whole — the profiler cannot cut
+                # inside one XLA dispatch.
+                capture.maybe_start(r, r + block - 1)
             if block > 1:
                 stacked = fed.run_on_device(block)
                 # Bulk transfers, not per-round scalar fetches — per-round
@@ -239,6 +260,8 @@ def main(argv=None) -> int:
                             "stage, by surface",
                             labels={"surface": "engine"},
                         ).inc(screened)
+                if getattr(fed, "profiler", None) is not None:
+                    rec.update(fed.profiler.record_fields())
                 if crossed_eval and i == len(per_round) - 1:
                     rec["test_loss"], rec["test_acc"] = fed.evaluate(*eval_data)
                 logger.log(ri, **rec)
@@ -247,13 +270,23 @@ def main(argv=None) -> int:
                     if "test_acc" in rec:
                         msg += f" test_acc {rec['test_acc']:.3f}"
                     bar.update(ri - start_round, msg)
+            if compile_w is not None and not compile_w.steady and (
+                crossed_eval or not args.eval_every
+            ):
+                # Every program this loop runs has now compiled (round body
+                # + eval); any further compile is a steady-state recompile.
+                compile_w.mark_steady()
             prev = r
             r += block
+            if capture is not None:
+                capture.maybe_stop(r)
             if ckpt is not None and (
                 r // args.checkpoint_every > prev // args.checkpoint_every
                 or r == cfg.fed.num_rounds
             ):
                 ckpt.save(r, fed.state)
+    if capture is not None:
+        capture.stop()  # idempotent: flush a window that spans the tail
     dt = time.time() - t0
     done = cfg.fed.num_rounds - start_round
     logging.info(
@@ -261,6 +294,8 @@ def main(argv=None) -> int:
     )
     if ckpt is not None:
         ckpt.close()  # drain the background writer before reporting done
+    if compile_w is not None:
+        compile_w.uninstall()  # listeners are process-global
     # Idempotent with the atexit/SIGTERM registration — crash paths flush
     # the same way this clean exit does.
     flush()

@@ -19,12 +19,15 @@ from fedtpu.cli.common import (
     add_model_flags,
     add_obs_flags,
     add_platform_flag,
+    add_profile_flags,
     add_robustness_flags,
     add_telemetry_export_flags,
     apply_platform_flag,
     build_config,
     compress_enabled,
+    install_compile_watcher,
     install_final_flush,
+    make_capture_window,
     make_chaos,
     make_checkpointer,
     make_flight_recorder,
@@ -70,6 +73,7 @@ def main(argv=None) -> int:
     )
     add_telemetry_export_flags(p)
     add_obs_flags(p)
+    add_profile_flags(p)
     add_robustness_flags(p)
     p.add_argument("-r", "--resume", action="store_true",
                    help="resume the global model from the latest checkpoint")
@@ -152,6 +156,19 @@ def main(argv=None) -> int:
         from fedtpu.obs import RoundRecordWriter
 
         metrics = RoundRecordWriter(path=args.metrics) if args.metrics else None
+        # Performance observatory: compile counting on /statusz (the server
+        # jits decode/aggregate/screening programs too) + the
+        # --profile-rounds device-trace window, driven from on_round below.
+        compile_w = install_compile_watcher(
+            telemetry=primary.telemetry, flight=flight
+        )
+        if compile_w is not None:
+            primary.compile_watcher = compile_w
+        capture = make_capture_window(
+            args, role="primary", telemetry=primary.telemetry
+        )
+        if capture is not None:
+            capture.maybe_start(0)
         # Exit-time exporters must survive SIGTERM, not just clean exits;
         # the same idempotent flush also serves the finally below.
         flush = install_final_flush(args, primary.telemetry, metrics=metrics)
@@ -165,6 +182,16 @@ def main(argv=None) -> int:
             primary.start_gate(args.gate)
 
         def on_round(r: int, rec: dict) -> None:
+            if capture is not None:
+                # on_round fires AFTER round r: close the window once it is
+                # past, (re)arm it for the round about to start.
+                capture.maybe_stop(r + 1)
+                capture.maybe_start(r + 1)
+            if compile_w is not None and not compile_w.steady and r >= 1:
+                # Round 0 compiles decode/aggregate (and screening, which
+                # jits on its first armed round); by the end of round 1 the
+                # steady set has run — later compiles are perf bugs.
+                compile_w.mark_steady()
             if metrics is not None:
                 metrics.log(start_round + r, **rec)
             # No checkpoint on a sub-quorum abort: the state is unchanged
@@ -189,6 +216,10 @@ def main(argv=None) -> int:
                     on_round=on_round,
                 )
         finally:
+            if capture is not None:
+                capture.stop()  # idempotent: flush a tail-spanning window
+            if compile_w is not None:
+                compile_w.uninstall()  # listeners are process-global
             if ckpt is not None:
                 # Drain the background writer FIRST: the final generation
                 # must be durable before the process reports done.

@@ -283,13 +283,53 @@ class Federation:
             role="engine", phase="init", round=0,
             num_clients=cfg.fed.num_clients,
         )
+        # Continuous MFU/roofline accounting (fedtpu.obs.profile): OPT-IN
+        # via enable_mfu_accounting() — building the cost model traces and
+        # AOT-compiles the round program once (seconds), which library
+        # users constructing many engines must not pay implicitly. The
+        # per-round observe is a few gauge sets (bench.py --mfu-microbench
+        # gates it ≤1% of a round).
+        self.profiler = None
+        # Optional process-wide CompileWatcher, attached by the owning CLI
+        # (jax.monitoring listeners are global, so the process owns it, not
+        # the engine) — surfaced on /statusz when present.
+        self.compile_watcher = None
+
+    def enable_mfu_accounting(self, xla_check: bool = True):
+        """Arm per-round MFU/roofline gauges + round-record stamping.
+
+        Builds the per-round cost model now (analytic jaxpr FLOP walk,
+        cross-checked against XLA ``cost_analysis`` when ``xla_check``) —
+        a one-time trace/compile cost, so this is explicit rather than a
+        construction default. Returns the :class:`RoundProfiler`."""
+        from fedtpu.obs.profile import RoundProfiler, engine_cost_model
+
+        if self.profiler is None:
+            if self.mesh is not None:
+                n_dev = len(self.mesh.devices.flatten())
+                kind = self.mesh.devices.flatten()[0].device_kind
+            else:
+                n_dev = 1
+                kind = jax.devices()[0].device_kind
+            self.profiler = RoundProfiler(
+                self.telemetry, n_devices=n_dev, device_kind=kind,
+            )
+            self.profiler.set_cost_model(
+                engine_cost_model(self, xla_check=xla_check)
+            )
+        return self.profiler
 
     def status_snapshot(self) -> dict:
-        """``/statusz`` feed: live round/phase plus the alive mask."""
+        """``/statusz`` feed: live round/phase plus the alive mask (and the
+        perf/compile observability blocks when armed)."""
         snap = self.status.snapshot()
         snap["alive"] = self.alive.tolist()
         if self.telemetry.tracer is not None:
             snap["trace_id"] = self.telemetry.tracer.trace_id
+        if self.profiler is not None:
+            snap["perf"] = self.profiler.snapshot()
+        if self.compile_watcher is not None:
+            snap["compile"] = self.compile_watcher.snapshot()
         return snap
 
     def _placed(self, x, sharded: bool):
@@ -519,8 +559,11 @@ class Federation:
         tel = self.telemetry
         r = self._round_number()
         self.status.update(round=r, phase="round")
+        t0 = time.perf_counter()
         with tel.span("round", round=r):
             metrics = self._step_impl(batch)
+        if self.profiler is not None:
+            self.profiler.observe_round(time.perf_counter() - t0)
         self.status.update(round=r + 1, phase="idle")
         tel.counter(
             "fedtpu_rounds_completed_total",
@@ -600,6 +643,7 @@ class Federation:
         r = self._round_number()
         self.status.update(round=r, phase="fused_rounds",
                            fused_block=num_rounds)
+        t0 = time.perf_counter()
         with tel.span("fused_rounds", round=r, num_rounds=num_rounds):
             alive = np.stack(
                 [self._alive_for_round(r + i) for i in range(num_rounds)]
@@ -626,6 +670,14 @@ class Federation:
                 alive_dev,
                 self._data_key,
                 *extra,
+            )
+        if self.profiler is not None:
+            # The fused dispatch is async; the stacked metrics fetch by the
+            # CALLER is the honest sync point, so this wall is dispatch
+            # latency on a device backend. CLI loops that fetch inside the
+            # block (fedtpu.cli.run does) get true per-round walls.
+            self.profiler.observe_round(
+                time.perf_counter() - t0, rounds=num_rounds
             )
         self._round_host = r + num_rounds
         self.status.update(round=r + num_rounds, phase="idle")
@@ -674,6 +726,12 @@ class Federation:
                 "fedtpu_round_wall_seconds",
                 "per-round host wall time (dispatch + sync)",
             ).observe(rec["round_s"])
+            if self.profiler is not None:
+                # step() already observed this round into the gauges; the
+                # record stamps the SAME last-round figures (absent when the
+                # cost model or the peak table can't derive them — e.g.
+                # unknown device kind without FEDTPU_PEAK_FLOPS).
+                rec.update(self.profiler.record_fields())
             if screen_on:
                 # The run() loop already syncs per round (worst_client_loss
                 # above), so reading the verdict mask costs nothing extra.

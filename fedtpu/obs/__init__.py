@@ -13,7 +13,10 @@ The observability subsystem the round/transport/FT stack reports into
 - :mod:`fedtpu.obs.http` — the live ``/metrics`` ``/healthz`` ``/statusz``
   endpoint (``--obs-port``) + the :class:`StatusBoard` it reads;
 - :mod:`fedtpu.obs.flight` — the crash flight recorder (ring buffer dumped
-  on unhandled exception, SIGUSR1, and failover transitions).
+  on unhandled exception, SIGUSR1, and failover transitions);
+- :mod:`fedtpu.obs.profile` — the performance observatory: continuous
+  MFU/roofline accounting, XLA compile observability, and the
+  ``--profile-rounds`` device-trace capture windows.
 
 :class:`Telemetry` bundles tracer+registry behind ``FedConfig.telemetry``
 (``off | basic | trace``). No jax import at module scope — config-only and
@@ -45,9 +48,29 @@ from fedtpu.obs.telemetry import (
     Telemetry,
     validate_telemetry_mode,
 )
+from fedtpu.obs.profile import (
+    CaptureWindow,
+    CompileWatcher,
+    CostModel,
+    RoundProfiler,
+    analytic_flops,
+    device_peaks,
+    latency_summary,
+    parse_round_window,
+    roofline,
+)
 from fedtpu.obs.trace import SpanTracer, load_chrome_trace, write_chrome_trace
 
 __all__ = [
+    "CaptureWindow",
+    "CompileWatcher",
+    "CostModel",
+    "RoundProfiler",
+    "analytic_flops",
+    "device_peaks",
+    "latency_summary",
+    "parse_round_window",
+    "roofline",
     "FlightRecorder",
     "ObsServer",
     "StatusBoard",

@@ -1,0 +1,663 @@
+"""Performance observatory: continuous MFU/roofline accounting + compile
+observability + profiler capture windows.
+
+The headline number rides on ~1.31% MFU (artifacts/MFU_PROFILE_r04*.json),
+but until this module that figure was a one-off hand-run artifact. Here the
+accounting becomes *continuous*:
+
+- :func:`analytic_flops` — an analytic per-architecture FLOP model that
+  walks the jaxpr counting matmul/conv MACs, cross-checked against XLA's
+  ``jax.jit(...).lower(...).compile().cost_analysis()`` (the two agree to a
+  few percent on every zoo model; the ratio is stamped on the cost model so
+  drift between them is visible, not silent).
+- :class:`RoundProfiler` — per-round ``fedtpu_step_time_seconds``,
+  ``fedtpu_achieved_flops_per_sec`` and ``fedtpu_mfu_ratio`` gauges through
+  the existing registry, plus a ``snapshot()`` dict for ``/statusz`` and
+  round records. Per-round cost is a handful of gauge sets (microseconds;
+  gated ≤1% of a round by ``bench.py --mfu-microbench``).
+- :class:`CompileWatcher` — counts and times XLA backend compilations via
+  ``jax.monitoring`` listeners, with a steady-state recompile detector
+  that warns + flight-records (silent steady-state recompiles are the
+  classic JAX perf killer: one drifting shape and every "fast" round pays
+  a multi-second compile).
+- :func:`capture_window` / :class:`CaptureWindow` — programmatic
+  ``jax.profiler`` windows (the CLIs' ``--profile-rounds N:M``) that also
+  write a ``profile_meta.json`` sidecar carrying the wall-clock start, so
+  ``tools/trace_merge.py`` can align device ops onto the host-span
+  timeline.
+
+Shared scalar conventions (same as bench.py): FLOPs/bytes are PER ROUND
+from the SINGLE-round program — XLA cost analysis counts a ``lax.scan``
+body once regardless of trip count, so the fused multi-round program
+reports the same flops as one round. ``analytic_flops`` deliberately
+follows the same scan-once convention so the cross-check compares like
+with like.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("fedtpu.obs.profile")
+
+# ------------------------------------------------------------- peak tables
+# Public per-chip peak figures by PJRT device_kind substring (matched on
+# the lowercase space/hyphen-stripped form): (bf16 FLOPs/s, HBM bytes/s).
+# Single source of truth — bench.py and tools/bench_profile_tpu.py resolve
+# through here.
+PEAK_TABLE: Tuple[Tuple[Tuple[str, ...], float, Optional[float]], ...] = (
+    (("v6e", "v6lite", "trillium"), 918e12, 1640e9),
+    (("v5p",), 459e12, 2765e9),
+    (("v5e", "v5lite"), 197e12, 819e9),
+    (("v4",), 275e12, 1228e9),
+    (("v3",), 123e12, 900e9),
+    (("v2",), 45e12, 700e9),
+)
+
+# Operator overrides for platforms the table cannot know (CPU dev boxes,
+# new chips): utilisation ratios against a wrong peak are worse than none.
+PEAK_FLOPS_ENV = "FEDTPU_PEAK_FLOPS"
+PEAK_HBM_ENV = "FEDTPU_PEAK_HBM_BYTES"
+
+
+def device_peaks(device_kind: str) -> Tuple[Optional[float], Optional[float]]:
+    """``(peak_flops_per_s, peak_hbm_bytes_per_s)`` for a PJRT device kind;
+    ``(None, None)`` when unknown (CPU, future chips). The ``FEDTPU_PEAK_*``
+    env overrides win over the table — the only way to get meaningful MFU
+    on hardware the table doesn't cover."""
+    peak_f = peak_b = None
+    kind = (device_kind or "").lower().replace(" ", "").replace("-", "")
+    for aliases, f, b in PEAK_TABLE:
+        if any(a in kind for a in aliases):
+            peak_f, peak_b = f, b
+            break
+    env_f = os.environ.get(PEAK_FLOPS_ENV)
+    env_b = os.environ.get(PEAK_HBM_ENV)
+    if env_f:
+        try:
+            peak_f = float(env_f)
+        except ValueError:
+            pass
+    if env_b:
+        try:
+            peak_b = float(env_b)
+        except ValueError:
+            pass
+    return peak_f, peak_b
+
+
+# -------------------------------------------------------- analytic FLOPs
+def _subjaxprs(params: dict):
+    """Yield every jaxpr nested in an eqn's params (pjit bodies, scan/while
+    bodies, cond branches, custom_* calls)."""
+    for val in params.values():
+        objs = val if isinstance(val, (list, tuple)) else (val,)
+        for obj in objs:
+            if hasattr(obj, "jaxpr"):  # ClosedJaxpr
+                yield obj.jaxpr
+            elif hasattr(obj, "eqns"):  # raw Jaxpr
+                yield obj
+
+
+def _dot_flops(eqn) -> float:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval.shape, eqn.invars[1].aval.shape
+    k = math.prod(lhs[i] for i in lc)
+    b = math.prod(lhs[i] for i in lb)
+    m = math.prod(
+        d for i, d in enumerate(lhs) if i not in lc and i not in lb
+    )
+    n = math.prod(
+        d for i, d in enumerate(rhs) if i not in rc and i not in rb
+    )
+    return 2.0 * b * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    dnums = eqn.params["dimension_numbers"]
+    rhs = eqn.invars[1].aval.shape
+    out = eqn.outvars[0].aval.shape
+    groups = eqn.params.get("feature_group_count", 1) or 1
+    # rhs_spec = (out_chan, in_chan_per_group, *spatial)
+    in_per_group = rhs[dnums.rhs_spec[1]]
+    k_spatial = math.prod(rhs[i] for i in dnums.rhs_spec[2:])
+    del groups  # in_chan axis of rhs is already per-group
+    return 2.0 * math.prod(out) * in_per_group * k_spatial
+
+
+def _count_jaxpr(jaxpr) -> float:
+    flops = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            flops += _dot_flops(eqn)
+        elif name == "conv_general_dilated":
+            flops += _conv_flops(eqn)
+        elif name == "cond":
+            # One branch executes; count the worst case.
+            branches = eqn.params.get("branches", ())
+            flops += max(
+                (_count_jaxpr(b.jaxpr) for b in branches), default=0.0
+            )
+        else:
+            # scan/while bodies counted ONCE (the module's convention);
+            # everything else recursed structurally.
+            for sub in _subjaxprs(eqn.params):
+                flops += _count_jaxpr(sub)
+    return flops
+
+
+def analytic_flops(fn: Callable, *args, **kwargs) -> float:
+    """Analytic FLOP count of ``fn(*args)``: 2 FLOPs per matmul/conv MAC,
+    read off the traced jaxpr's shapes. Elementwise/reduction ops are
+    excluded (MXU work dominates every zoo model by orders of magnitude);
+    ``lax.scan``/``while`` bodies are counted once — the same convention as
+    XLA's ``cost_analysis`` (see module docstring), so the two are directly
+    comparable."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _count_jaxpr(closed.jaxpr)
+
+
+def xla_cost(compiled) -> Dict[str, float]:
+    """``{"flops": ..., "bytes": ...}`` from a compiled executable's
+    ``cost_analysis()`` (normalising the list-wrapped form some PJRT
+    versions return); zeros when unavailable."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return {"flops": 0.0, "bytes": 0.0}
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return {
+        "flops": float(analysis.get("flops", 0.0)),
+        "bytes": float(analysis.get("bytes accessed", 0.0)),
+    }
+
+
+def roofline(
+    flops: Optional[float],
+    bytes_accessed: Optional[float],
+    peak_flops: Optional[float],
+    peak_bw: Optional[float],
+    achieved_flops_per_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Classic roofline classification for one program execution. Returns
+    ``arith_intensity_flops_per_byte``, ``ridge_point_flops_per_byte``,
+    ``roofline_bound`` ("compute" | "bandwidth") and, when an achieved rate
+    is given, ``roofline_utilization`` = achieved / ceiling-at-intensity.
+    Keys are present-but-None when an input is missing — schema-stable for
+    the ``--mfu-profile`` artifact."""
+    out: Dict[str, Any] = {
+        "arith_intensity_flops_per_byte": None,
+        "ridge_point_flops_per_byte": None,
+        "roofline_bound": None,
+        "roofline_utilization": None,
+    }
+    if flops and bytes_accessed:
+        out["arith_intensity_flops_per_byte"] = round(
+            flops / bytes_accessed, 3
+        )
+    if peak_flops and peak_bw:
+        out["ridge_point_flops_per_byte"] = round(peak_flops / peak_bw, 3)
+    ai = out["arith_intensity_flops_per_byte"]
+    ridge = out["ridge_point_flops_per_byte"]
+    if ai is not None and ridge is not None:
+        out["roofline_bound"] = "compute" if ai >= ridge else "bandwidth"
+        if achieved_flops_per_s:
+            ceiling = (
+                peak_flops if ai >= ridge else peak_bw * ai
+            )
+            if ceiling:
+                out["roofline_utilization"] = round(
+                    achieved_flops_per_s / ceiling, 6
+                )
+    return out
+
+
+# ------------------------------------------------------------- cost model
+class CostModel:
+    """Per-round FLOP/byte figures for one round program, carrying both the
+    analytic count and the XLA cost-analysis one plus their agreement
+    ratio. ``flops`` prefers XLA (it sees the post-optimisation HLO);
+    analytic is the cross-check and the fallback when AOT compilation is
+    unavailable (e.g. shard_map paths on some backends)."""
+
+    def __init__(
+        self,
+        xla_flops: Optional[float] = None,
+        xla_bytes: Optional[float] = None,
+        analytic: Optional[float] = None,
+    ):
+        self.xla_flops = xla_flops or None
+        self.xla_bytes = xla_bytes or None
+        self.analytic = analytic or None
+        self.flops = self.xla_flops or self.analytic
+        self.source = (
+            "xla" if self.xla_flops else
+            ("analytic" if self.analytic else None)
+        )
+        self.agreement = (
+            round(self.analytic / self.xla_flops, 4)
+            if self.analytic and self.xla_flops else None
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "flops_per_round": self.flops,
+            "bytes_per_round": self.xla_bytes,
+            "analytic_flops_per_round": self.analytic,
+            "flops_source": self.source,
+            "analytic_vs_xla": self.agreement,
+        }
+
+
+def engine_cost_model(fed, xla_check: bool = True) -> CostModel:
+    """Build the per-round :class:`CostModel` for a
+    :class:`fedtpu.core.engine.Federation`'s device-data round program:
+    analytic jaxpr walk + (with ``xla_check``, best-effort) AOT compile for
+    ``cost_analysis``. One-time cost at first use (the AOT compile hits
+    the persistent XLA compile cache the engine already enables)."""
+    import jax.numpy as jnp
+
+    d_images, d_labels, d_idx, d_mask = fed._ensure_device_data()
+    n = fed.cfg.fed.num_clients
+    alive = fed._placed(
+        jnp.ones((n,), bool), sharded=fed.mesh is not None
+    )
+    extra = ()
+    if fed._attack_seats is not None:
+        extra = (jnp.asarray(fed._attack_seats),)
+    args = (
+        fed.state, d_images, d_labels, d_idx, d_mask, fed.weights, alive,
+        fed._data_key, *extra,
+    )
+    analytic = None
+    try:
+        analytic = analytic_flops(fed._data_step, *args)
+    except Exception as e:  # pragma: no cover - backend quirks
+        log.debug("analytic FLOP model failed: %s", e)
+    xf = xb = None
+    if xla_check:
+        try:
+            compiled = fed._data_step.lower(*args).compile()
+            cost = xla_cost(compiled)
+            xf, xb = cost["flops"], cost["bytes"]
+        except Exception as e:  # pragma: no cover - backend quirks
+            log.debug("XLA cost analysis unavailable: %s", e)
+    return CostModel(xla_flops=xf, xla_bytes=xb, analytic=analytic)
+
+
+# ---------------------------------------------------------- round profiler
+class RoundProfiler:
+    """Continuous per-round MFU/step-time accounting through one Telemetry.
+
+    ``observe_round(wall_s, rounds=n)`` after each dispatch sets three
+    gauges and returns the derived dict for round-record stamping. All
+    per-round work is arithmetic + gauge sets (no device sync, no
+    compile); the cost model is attached once via :meth:`set_cost_model`.
+    """
+
+    def __init__(
+        self,
+        telemetry,
+        n_devices: int = 1,
+        device_kind: str = "",
+    ):
+        self.telemetry = telemetry
+        self.n_devices = max(1, int(n_devices))
+        self.device_kind = device_kind
+        self.peak_flops, self.peak_bw = device_peaks(device_kind)
+        self.cost: Optional[CostModel] = None
+        self._last: Dict[str, Any] = {}
+        self._rounds = 0
+
+    def set_cost_model(self, cost: CostModel) -> None:
+        self.cost = cost
+
+    def observe_round(self, wall_s: float, rounds: int = 1) -> Dict[str, Any]:
+        """Account one dispatch of ``rounds`` fused rounds taking ``wall_s``
+        seconds; returns ``{step_time_s, achieved_flops_per_s, mfu}``
+        (items None when underivable) after updating the gauges."""
+        tel = self.telemetry
+        step_s = wall_s / max(1, rounds)
+        self._rounds += rounds
+        out: Dict[str, Any] = {
+            "step_time_s": step_s,
+            "achieved_flops_per_s": None,
+            "mfu": None,
+        }
+        tel.gauge(
+            "fedtpu_step_time_seconds",
+            "wall time of the last round dispatch, per round",
+        ).set(step_s)
+        flops = self.cost.flops if self.cost else None
+        if flops and wall_s > 0:
+            achieved = flops * rounds / wall_s
+            out["achieved_flops_per_s"] = achieved
+            tel.gauge(
+                "fedtpu_achieved_flops_per_sec",
+                "model FLOPs retired per second over the last dispatch "
+                "(all devices)",
+            ).set(achieved)
+            if self.peak_flops:
+                mfu = achieved / (self.n_devices * self.peak_flops)
+                out["mfu"] = mfu
+                tel.gauge(
+                    "fedtpu_mfu_ratio",
+                    "model FLOPs utilization of the last dispatch vs "
+                    "per-chip peak (device_peaks table or FEDTPU_PEAK_FLOPS)",
+                ).set(mfu)
+        self._last = out
+        return out
+
+    def record_fields(self) -> Dict[str, Any]:
+        """Rounded stamps for a v1 round record from the last observation
+        (empty before any round / when underivable) — the round loops merge
+        this into each record they emit."""
+        out: Dict[str, Any] = {}
+        last = self._last
+        if last.get("achieved_flops_per_s"):
+            out["achieved_flops_per_s"] = round(
+                last["achieved_flops_per_s"], 1
+            )
+        if last.get("mfu") is not None:
+            out["mfu"] = round(last["mfu"], 6)
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/statusz`` perf block: last-round derived figures + the
+        static cost model and peaks."""
+        snap: Dict[str, Any] = {
+            "device_kind": self.device_kind,
+            "n_devices": self.n_devices,
+            "peak_flops_per_s": self.peak_flops,
+            "rounds_observed": self._rounds,
+        }
+        if self.cost is not None:
+            snap.update(self.cost.as_dict())
+        snap.update(self._last)
+        if self.cost is not None and self._last.get("achieved_flops_per_s"):
+            snap.update(roofline(
+                self.cost.flops, self.cost.xla_bytes,
+                self.peak_flops, self.peak_bw,
+                self._last["achieved_flops_per_s"] / self.n_devices,
+            ))
+        return snap
+
+
+# ------------------------------------------------------- latency summaries
+def latency_summary(
+    pairs: Sequence[Tuple[str, float]], top_k: int = 3
+) -> Dict[str, Any]:
+    """p50/p95/p99 + top-k slowest over ``(client, seconds)`` pairs — the
+    straggler-attribution block on server round records and ``/statusz``.
+    Empty input yields ``{}`` (rounds with no completed RPCs)."""
+    if not pairs:
+        return {}
+    lats = sorted(v for _, v in pairs)
+
+    def pct(p: float) -> float:
+        # Nearest-rank percentile: exact at small n, no interpolation.
+        i = min(len(lats) - 1, max(0, math.ceil(p / 100.0 * len(lats)) - 1))
+        return round(lats[i], 6)
+
+    slowest = sorted(pairs, key=lambda cv: cv[1], reverse=True)[:top_k]
+    return {
+        "n": len(pairs),
+        "p50_s": pct(50),
+        "p95_s": pct(95),
+        "p99_s": pct(99),
+        "max_s": round(lats[-1], 6),
+        "slowest": [[c, round(v, 6)] for c, v in slowest],
+    }
+
+
+# --------------------------------------------------------- compile watcher
+_COMPILE_EVENT_SUBSTR = "backend_compile"
+
+
+class CompileWatcher:
+    """Count + time XLA compilations via ``jax.monitoring`` duration events
+    (``/jax/core/compile/backend_compile_duration`` fires once per backend
+    compile). After :meth:`mark_steady` — the owner's signal that every
+    program it intends to run has warmed up — any further compile is a
+    *steady-state recompile*: it warns, flight-records, and bumps
+    ``fedtpu_xla_recompiles_steady_total``, because a recompile inside the
+    round loop silently turns a ~ms round into a multi-second one.
+
+    ``install()``/``uninstall()`` manage the process-global listener; one
+    active watcher per process (the registration API has no scoping)."""
+
+    _active: Optional["CompileWatcher"] = None
+
+    def __init__(self, telemetry=None, flight=None):
+        self.telemetry = telemetry
+        self.flight = flight
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.recompiles_after_steady = 0
+        self._steady = False
+        self._installed = False
+        self._lock = threading.Lock()
+
+    # The listener survives uninstall() in jax versions without an
+    # unregister API — the _installed gate keeps it inert.
+    def _listener(self, event: str, duration: float, **kwargs) -> None:
+        if not self._installed or _COMPILE_EVENT_SUBSTR not in event:
+            return
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += duration
+            steady = self._steady
+            if steady:
+                self.recompiles_after_steady += 1
+        tel = self.telemetry
+        if tel is not None:
+            tel.counter(
+                "fedtpu_xla_compiles_total",
+                "XLA backend compilations observed by this process",
+            ).inc()
+            tel.histogram(
+                "fedtpu_xla_compile_seconds",
+                "XLA backend compile wall time per executable",
+            ).observe(duration)
+        if steady:
+            log.warning(
+                "steady-state XLA recompile (%.2fs): a program shape or "
+                "constant drifted after warmup — the classic silent round "
+                "slowdown (compiles so far: %d)", duration, self.compiles,
+            )
+            if tel is not None:
+                tel.counter(
+                    "fedtpu_xla_recompiles_steady_total",
+                    "XLA compilations after the owner declared steady "
+                    "state (each one is a latent perf bug)",
+                ).inc()
+            if self.flight is not None:
+                self.flight.record(
+                    "xla_recompile",
+                    duration_s=round(duration, 4),
+                    compiles_total=self.compiles,
+                )
+
+    def install(self) -> "CompileWatcher":
+        if self._installed:
+            return self
+        if CompileWatcher._active is not None:
+            raise RuntimeError(
+                "another CompileWatcher is already installed in this "
+                "process (jax.monitoring listeners are global)"
+            )
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._listener)
+        self._installed = True
+        CompileWatcher._active = self
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        if CompileWatcher._active is self:
+            CompileWatcher._active = None
+        try:  # best-effort: the public API grew unregister late
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_duration_listener_by_callback(
+                self._listener
+            )
+        except Exception:
+            pass  # inert via the _installed gate
+
+    def mark_steady(self) -> None:
+        with self._lock:
+            self._steady = True
+
+    @property
+    def steady(self) -> bool:
+        return self._steady
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 4),
+                "steady": self._steady,
+                "recompiles_after_steady": self.recompiles_after_steady,
+            }
+
+
+# -------------------------------------------------------- capture windows
+PROFILE_META = "profile_meta.json"
+
+
+def parse_round_window(spec: str) -> Tuple[int, int]:
+    """Parse ``--profile-rounds N:M`` into a half-open ``[N, M)`` round
+    window (``"3:5"`` captures rounds 3 and 4). A bare ``N`` means one
+    round ``[N, N+1)``."""
+    try:
+        if ":" in spec:
+            a, b = spec.split(":", 1)
+            lo, hi = int(a), int(b)
+        else:
+            lo = int(spec)
+            hi = lo + 1
+    except ValueError:
+        raise ValueError(
+            f"--profile-rounds wants N:M (half-open round window), "
+            f"got {spec!r}"
+        )
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"--profile-rounds window must satisfy 0 <= N < M, got {spec!r}"
+        )
+    return lo, hi
+
+
+def write_profile_meta(
+    trace_dir: str, role: str = "", trace_id: Optional[str] = None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Drop the ``profile_meta.json`` sidecar into a profiler output dir:
+    ``wall_start`` (wall clock at capture start — device-trace timestamps
+    are relative to it) + role/trace_id for lane naming and federation
+    stitching. This is what lets ``tools/trace_merge.py`` put device ops on
+    the same wall-clock timeline as host spans."""
+    meta = {
+        "wall_start": time.time(),
+        "role": role,
+        "trace_id": trace_id,
+        "format": "jax.profiler",
+    }
+    if extra:
+        meta.update(extra)
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, PROFILE_META)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, path)
+    return path
+
+
+class CaptureWindow:
+    """Round-windowed ``jax.profiler`` capture for a round loop.
+
+    The loop calls :meth:`maybe_start` with the first round of the block it
+    is about to dispatch and :meth:`maybe_stop` with the next round index
+    after it completes; the window opens before the first block that
+    overlaps ``[lo, hi)`` and closes after the block that reaches ``hi``.
+    Fused blocks are captured whole (the profiler cannot cut inside one
+    dispatch). ``stop()`` is idempotent and must be called on loop exit so
+    a window that spans the tail still flushes."""
+
+    def __init__(
+        self, spec: str, trace_dir: str,
+        role: str = "", trace_id: Optional[str] = None,
+    ):
+        self.lo, self.hi = parse_round_window(spec)
+        self.trace_dir = trace_dir
+        self.role = role
+        self.trace_id = trace_id
+        self._ctx = None
+
+    @property
+    def active(self) -> bool:
+        return self._ctx is not None
+
+    def maybe_start(self, first_round: int, last_round: int = None) -> None:
+        """Open the window if block ``[first_round, last_round]`` overlaps
+        it (``last_round`` defaults to ``first_round``)."""
+        if self._ctx is not None:
+            return
+        last = first_round if last_round is None else last_round
+        if first_round >= self.hi or last < self.lo:
+            return
+        import jax
+
+        write_profile_meta(
+            self.trace_dir, role=self.role, trace_id=self.trace_id,
+            extra={"round_window": [self.lo, self.hi]},
+        )
+        self._ctx = jax.profiler.trace(self.trace_dir)
+        self._ctx.__enter__()
+        log.info(
+            "profiler capture window open: rounds [%d, %d) -> %s",
+            self.lo, self.hi, self.trace_dir,
+        )
+
+    def maybe_stop(self, next_round: int) -> None:
+        if self._ctx is not None and next_round >= self.hi:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._ctx is None:
+            return
+        ctx, self._ctx = self._ctx, None
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as e:  # pragma: no cover - profiler teardown
+            log.warning("profiler capture stop failed: %s", e)
+        else:
+            log.info("profiler capture window closed: %s", self.trace_dir)
+
+
+def find_device_trace(trace_dir: str) -> Optional[str]:
+    """Locate the newest ``*.trace.json.gz`` a ``jax.profiler.trace``
+    session wrote under ``trace_dir`` (layout:
+    ``plugins/profile/<run>/<host>.trace.json.gz``); None if absent."""
+    hits: List[str] = []
+    for dirpath, _dirs, files in os.walk(trace_dir):
+        for f in files:
+            if f.endswith(".trace.json.gz") or f.endswith(".trace.json"):
+                hits.append(os.path.join(dirpath, f))
+    return max(hits, key=os.path.getmtime) if hits else None

@@ -677,6 +677,10 @@ class PrimaryServer:
         # updates round/phase as it moves; status_snapshot() adds the
         # registry-backed liveness/failure context.
         self.status = StatusBoard(role="primary", phase="init", round=0)
+        # XLA compile observability (obs/profile.py): the CLI installs a
+        # CompileWatcher and hands it over so /statusz can surface compile
+        # counts + steady-state recompile warnings.
+        self.compile_watcher = None
         self.model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
         shape = dataset_info(cfg.data.dataset)[0]
         variables = self.model.init(
@@ -1465,10 +1469,13 @@ class PrimaryServer:
                 for k in (
                     "participants", "stragglers", "bytes_up", "bytes_down",
                     "t_collect_s", "t_decode_s", "t_h2d_s", "t_aggregate_s",
-                    "t_post_barrier_s", "pipeline",
+                    "t_post_barrier_s", "t_round_s", "pipeline",
+                    "client_latency",
                 )
                 if k in last
             }
+        if self.compile_watcher is not None:
+            snap["compile"] = self.compile_watcher.snapshot()
         return snap
 
     # ------------------------------------------------------------ round loop
@@ -1533,6 +1540,11 @@ class PrimaryServer:
                     "per-round phase wall time by phase label",
                     labels={"phase": ph},
                 ).observe(rec[f"t_{ph}_s"])
+            if "t_round_s" in rec:
+                tel.gauge(
+                    "fedtpu_step_time_seconds",
+                    "wall time of the last round dispatch, per round",
+                ).set(rec["t_round_s"])
         return rec
 
     def _round_body(self, rspan) -> dict:
@@ -1614,6 +1626,11 @@ class PrimaryServer:
 
         # results[client] = (delta_tree | row_index, num_examples)
         results: Dict[str, tuple] = {}
+        # Straggler attribution: per-client StartTrain wall (RPC + decode,
+        # retries included) recorded by each collect worker under its own
+        # key (GIL-atomic single-key writes, same pattern as `results`).
+        # Summarised to p50/p95/p99 + top-k slowest on the round record.
+        latencies: Dict[str, float] = {}
         # Wire + phase accounting: thread-safe counters (fedtpu.obs), NOT
         # bare mutable cells — collect workers increment them concurrently,
         # and unsynchronised `x[0] += n` read-modify-writes can drop
@@ -1734,11 +1751,18 @@ class PrimaryServer:
                 return out
 
             try:
+                t_rpc = time.monotonic()
                 with tel.span("client_rpc", parent=rspan.id, client=client):
                     results[client] = call_with_retry(
                         self.retry_policy, "StartTrain", attempt,
                         peer=client, telemetry=tel,
                     )
+                latencies[client] = time.monotonic() - t_rpc
+                tel.histogram(
+                    "fedtpu_client_rpc_seconds",
+                    "per-client StartTrain wall time (RPC + decode, "
+                    "retries included; successful rounds only)",
+                ).observe(latencies[client])
             except (grpc.RpcError, wire.WireError) as e:
                 # Only a FATAL status or an exhausted retry budget lands
                 # here — the designed path to mark_failed.
@@ -2170,7 +2194,18 @@ class PrimaryServer:
             "t_h2d_s": round(h2d_s.value, 6),
             "t_aggregate_s": round(t_done - t_barrier, 6),
             "t_post_barrier_s": round(t_done - t_barrier, 6),
+            "t_round_s": round(t_done - t_launch, 6),
         }
+        from fedtpu.obs.profile import latency_summary
+
+        lat = latency_summary(
+            [(c, latencies[c]) for c in completed if c in latencies]
+        )
+        if lat:
+            # Straggler attribution: percentile spread + named top-3
+            # slowest — the "which client is dragging the barrier" readout
+            # the per-phase sums can't give (collect is launch->LAST join).
+            rec["client_latency"] = lat
         if self._weights_ignored:
             # Operator-facing flag (satellite): the robust aggregator ran
             # UNWEIGHTED even though weighted=True — by design, not a bug.

@@ -599,3 +599,102 @@ def test_rolling_upgrade_artifact_contract():
     assert counts["control"] == counts["upgraded"]
     # The mid-run joiner is in the final roster (one more than startup).
     assert result["roster"]["upgraded"]["size"] == cfg["clients"] + 1
+
+
+# ------------------------------------------- performance observatory legs
+@pytest.mark.slow
+def test_mfu_profile_schema_contract(monkeypatch, tmp_path):
+    """``bench.py --mfu-profile`` schema at a CPU smoke config: the sweep
+    rows carry the timing + cost-analysis + roofline keys the MFU_PROFILE_*
+    consumers read. The wrapper reloads tools/bench_profile_tpu so the
+    FEDTPU_SMOKE/PLATFORM knobs bind; here we drive run() directly at an
+    even smaller shape and redirect its artifact dir via __file__."""
+    import importlib
+    import json as json_mod
+    import os
+
+    monkeypatch.setenv("FEDTPU_PLATFORM", "cpu")
+    monkeypatch.setenv("FEDTPU_SMOKE", "1")  # float32, no traced dispatch
+    # Peak overrides so the roofline block derives on the CPU backend.
+    monkeypatch.setenv("FEDTPU_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("FEDTPU_PEAK_HBM_BYTES", "5e10")
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    monkeypatch.syspath_prepend(tools)
+    import bench_profile_tpu as bpt
+
+    bpt = importlib.reload(bpt)  # bind the smoke constants
+    monkeypatch.setattr(bpt, "NUM_CLIENTS", 2)
+    monkeypatch.setattr(bpt, "STEPS_PER_ROUND", 1)
+    monkeypatch.setattr(bpt, "TIMED_ROUNDS", 2)
+    monkeypatch.setattr(bpt, "BATCHES", (8,))
+    monkeypatch.setattr(bpt, "TRIALS", 1)
+    assert bpt.TRACE_DISPATCH is False  # smoke default: no CPU op-trace
+    # run() roots the artifacts dir off __file__ — point it into tmp.
+    monkeypatch.setattr(
+        bpt, "__file__", str(tmp_path / "tools" / "bench_profile_tpu.py")
+    )
+    result = bpt.run(tag="pytest")
+    assert result["timed_rounds_per_dispatch"] == 2
+    assert result["num_clients"] == 2
+    assert result["steps_per_round"] == 1
+    assert len(result["configs"]) == 1
+    row = result["configs"][0]
+    assert row["batch"] == 8
+    assert row["rounds_per_sec"] > 0
+    assert row["sec_per_fused_dispatch"] > 0
+    assert len(row["trial_times_s"]) == 1
+    assert row["device_kind"]
+    assert row["flops_per_round"] > 0 and row["bytes_per_round"] > 0
+    # Shared peak-table/roofline path (fedtpu.obs.profile): with peaks
+    # overridden the MFU + roofline placement must all derive.
+    assert 0 < row["mfu"] < 1
+    assert row["hbm_util"] > 0
+    assert row["arith_intensity_flops_per_byte"] == pytest.approx(
+        row["flops_per_round"] / row["bytes_per_round"], rel=1e-2
+    )
+    assert row["ridge_point_flops_per_byte"] == pytest.approx(20.0)
+    assert row["roofline_bound"] in ("compute", "bandwidth")
+    assert row["roofline_utilization"] > 0
+    # Incremental artifact persist landed in the redirected dir.
+    with open(tmp_path / "artifacts" / "MFU_PROFILE_pytest.json") as fh:
+        assert json_mod.load(fh) == result
+
+
+@pytest.mark.slow
+def test_mfu_microbench_contract(bench, monkeypatch, tmp_path):
+    """``bench.py --mfu-microbench`` at a seconds-scale mlp config: schema,
+    artifact emission, and the estimator invariants (attributable cost =
+    per-round accounting over the bare round wall; the densenet-scale <=1%
+    gate itself is pinned by the committed artifact in test_perf_obs.py)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_MF_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_MF_CLIENTS", "2")
+    monkeypatch.setenv("FEDTPU_MF_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_MF_REPS", "1")
+    monkeypatch.setenv("FEDTPU_MF_BATCH", "8")
+    result = bench._mfu_microbench()
+    assert result["metric"] == "mfu_accounting_overhead"
+    assert result["gate_pct"] == 1.0
+    assert result["value"] > 0
+    assert result["passes_gate"] == (result["value"] <= 1.0)
+    assert result["per_round_accounting_us"] > 0
+    assert result["value"] == pytest.approx(
+        result["per_round_accounting_us"]
+        / (result["round_ms"]["off"] * 1e3) * 100.0,
+        rel=0.05,
+    )
+    assert result["cost_model_build_s"] > 0
+    assert result["flops_per_round"] > 0
+    assert result["flops_source"] in ("analytic", "xla")
+    # FEDTPU_PEAK_FLOPS defaulted in by the bench: the full gauge path ran.
+    assert result["sample_mfu"] is not None and result["sample_mfu"] > 0
+    assert result["model"] == "mlp" and result["num_clients"] == 2
+    assert set(result["round_ms"]) == {"off", "mfu"}
+    with open(art / "MFU_ACCOUNTING_MICROBENCH.json") as fh:
+        assert json_mod.load(fh) == result

@@ -229,6 +229,16 @@ def test_two_client_trace_run_feeds_both_exporters(tmp_path):
         assert rec["participants"] == 2
         assert rec["bytes_up"] > 0 and rec["bytes_down"] > 0
         assert rec["t_collect_s"] > 0 and rec["t_aggregate_s"] >= 0
+        # Straggler attribution (performance observatory): whole-round wall
+        # plus the per-client StartTrain latency spread with the named
+        # slowest clients.
+        assert rec["t_round_s"] >= rec["t_collect_s"]
+        lat = rec["client_latency"]
+        assert lat["n"] == 2
+        assert 0 < lat["p50_s"] <= lat["p95_s"] <= lat["p99_s"] <= lat["max_s"]
+        assert 1 <= len(lat["slowest"]) <= 3
+        slowest_client, slowest_s = lat["slowest"][0]
+        assert slowest_client in addrs and slowest_s == lat["max_s"]
 
     # Prometheus exporter: parses, and the counters carry the run.
     prom_path = str(tmp_path / "metrics.prom")
@@ -240,6 +250,12 @@ def test_two_client_trace_run_feeds_both_exporters(tmp_path):
         r["bytes_up"] for r in recs
     )
     assert parsed["fedtpu_round_phase_seconds_count"]["phase=decode"] == 2
+    # One StartTrain latency observation per client per round.
+    assert parsed["fedtpu_client_rpc_seconds_count"][""] == 4
+    # The whole-round step-time gauge tracks the last round's record.
+    assert parsed["fedtpu_step_time_seconds"][""] == pytest.approx(
+        recs[-1]["t_round_s"], abs=5e-3
+    )
 
     # Trace exporter: Perfetto-loadable, phases nest under their round.
     trace_path = str(tmp_path / "trace.json")

@@ -41,26 +41,21 @@ TRIALS = 3
 BATCHES = (128, 256, 512)
 
 # FEDTPU_SMOKE=1: tiny shapes so the full code path (compile, time, roofline,
-# trace, incremental persist) can be exercised on the CPU backend in seconds.
+# incremental persist) can be exercised on the CPU backend in seconds. The
+# op-trace leg defaults OFF in smoke mode: jax.profiler instrumentation of
+# the fused program on the CPU backend runs >300x slower than untraced
+# (observed wedged >5 min on a <1 s dispatch); FEDTPU_PROFILE_TRACE=1/0
+# overrides either default.
 if os.environ.get("FEDTPU_SMOKE"):
     NUM_CLIENTS, STEPS_PER_ROUND, TIMED_ROUNDS, BATCHES = 8, 2, 2, (16, 32)
-
-# (peak bf16 FLOPs/sec, HBM GB/s) per chip by device kind substring.
-_PEAKS = (
-    (("v6e", "v6lite", "trillium"), 918e12, 1640e9),
-    (("v5p",), 459e12, 2765e9),
-    (("v5e", "v5lite"), 197e12, 819e9),
-    (("v4",), 275e12, 1228e9),
-)
-
-
-def _peaks_for(kind):
-    k = kind.lower().replace(" ", "").replace("-", "")
-    for aliases, f, b in _PEAKS:
-        if any(a in k for a in aliases):
-            return f, b
-    return None, None
-
+    # float32 + a single trial: CPU bf16 emulation is ~30x slower than f32
+    # (measured 17.7 s for a 2-round smallcnn dispatch) — smoke is about
+    # exercising the code path, not the MXU numerics.
+    TRIALS, DTYPE = 1, "float32"
+    TRACE_DISPATCH = os.environ.get("FEDTPU_PROFILE_TRACE", "0") == "1"
+else:
+    DTYPE = "bfloat16"
+    TRACE_DISPATCH = os.environ.get("FEDTPU_PROFILE_TRACE", "1") == "1"
 
 def _log(msg):
     print(f"[bench_profile_tpu] {msg}", file=sys.stderr, flush=True)
@@ -72,6 +67,7 @@ def _measure_config(batch, profile_dir=None):
 
     from fedtpu.config import DataConfig, FedConfig, OptimizerConfig, RoundConfig
     from fedtpu.core.engine import Federation
+    from fedtpu.obs.profile import device_peaks, roofline
 
     cfg = RoundConfig(
         model="smallcnn",
@@ -85,7 +81,7 @@ def _measure_config(batch, profile_dir=None):
         ),
         fed=FedConfig(num_clients=NUM_CLIENTS),
         steps_per_round=STEPS_PER_ROUND,
-        dtype="bfloat16",
+        dtype=DTYPE,
     )
     fed = Federation(cfg, seed=0)
     d_images, d_labels, d_idx, d_mask = fed._ensure_device_data()
@@ -129,7 +125,7 @@ def _measure_config(batch, profile_dir=None):
         t0 = time.perf_counter()
         state = dispatch(state)
         times.append(time.perf_counter() - t0)
-    if profile_dir:
+    if profile_dir and TRACE_DISPATCH:
         os.makedirs(profile_dir, exist_ok=True)
         _log(f"batch={batch}: tracing one dispatch -> {profile_dir}")
         with jax.profiler.trace(profile_dir):
@@ -139,7 +135,10 @@ def _measure_config(batch, profile_dir=None):
     rounds_per_sec = TIMED_ROUNDS / sec_per_dispatch
 
     kind = jax.devices()[0].device_kind
-    peak_f, peak_b = _peaks_for(kind)
+    # Shared peak table + roofline math (fedtpu.obs.profile) — the same
+    # numbers the engine's continuous MFU accounting uses, so a hand sweep
+    # and the per-round fedtpu_mfu_ratio gauge can never disagree on peaks.
+    peak_f, peak_b = device_peaks(kind)
     row = {
         "batch": batch,
         "rounds_per_sec": round(rounds_per_sec, 3),
@@ -157,18 +156,18 @@ def _measure_config(batch, profile_dir=None):
         if peak_b:
             row["hbm_util"] = round(rounds_per_sec * by / peak_b, 4)
     if flops and by and peak_f and peak_b:
-        intensity = flops / by
-        ridge = peak_f / peak_b
-        row["arith_intensity_flops_per_byte"] = round(intensity, 2)
-        row["ridge_point_flops_per_byte"] = round(ridge, 2)
-        row["roofline_bound"] = "compute" if intensity >= ridge else "bandwidth"
-        # Fraction of the roofline-implied ceiling actually achieved.
-        ceiling_rps = (peak_f / flops) if intensity >= ridge else (peak_b / by)
-        row["roofline_utilization"] = round(rounds_per_sec / ceiling_rps, 4)
+        roof = roofline(
+            flops, by, peak_f, peak_b,
+            achieved_flops_per_s=rounds_per_sec * flops,
+        )
+        row.update({k: v for k, v in roof.items() if v is not None})
     return row
 
 
-def main():
+def run(tag=None):
+    """The full sweep: measure every batch config, persist the artifact
+    incrementally, return the result dict. ``bench.py --mfu-profile`` calls
+    this; ``main()`` below is the standalone CLI wrapper."""
     # FEDTPU_PLATFORM=cpu pins the platform for smoke-testing this script
     # off-chip (the axon TPU plugin ignores JAX_PLATFORMS; only the config
     # update before any device query works — see tests/conftest.py).
@@ -180,7 +179,8 @@ def main():
     # FEDTPU_PROFILE_TAG distinguishes re-measurements (e.g. the presharded
     # data layout vs the r04 gather-layout baseline) without overwriting the
     # earlier artifact.
-    tag = os.environ.get("FEDTPU_PROFILE_TAG", "r04")
+    if tag is None:
+        tag = os.environ.get("FEDTPU_PROFILE_TAG", "r04")
     art = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                        "artifacts")
     os.makedirs(art, exist_ok=True)
@@ -204,7 +204,11 @@ def main():
         with open(tmp, "w") as f:
             json.dump(result, f, indent=2)
         os.replace(tmp, out)
-    print(json.dumps(result))
+    return result
+
+
+def main():
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":

@@ -1,12 +1,13 @@
 #!/usr/bin/env python
-"""Span-name drift check: every span the framework emits must be documented.
+"""Span- and metric-name drift check: everything emitted must be documented.
 
 Scans ``fedtpu/`` for literal span names passed to ``*.span("name", ...)``
-and verifies each appears as inline code (`` `name` ``) in
-``docs/OBSERVABILITY.md``'s span table. Catches the silent failure mode
-where a new subsystem adds spans (or renames one) and the operator-facing
-span model drifts out of date — dashboards and trace queries then filter
-on names that no longer exist.
+and literal metric names passed to ``.counter/.gauge/.histogram(...)``, and
+verifies each appears as inline code (`` `name` ``) in
+``docs/OBSERVABILITY.md``. Catches the silent failure mode where a new
+subsystem adds spans or ``fedtpu_*`` metrics (or renames one) and the
+operator-facing model drifts out of date — dashboards, alerts and trace
+queries then filter on names that no longer exist.
 
 Tier-1 runnable: ``tests/test_obs_propagation.py`` calls :func:`check`;
 standalone: ``python tools/span_check.py`` (exit 1 + a list on drift).
@@ -26,6 +27,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # — fedtpu's span names are deliberately all literal (greppability is the
 # point of a fixed span vocabulary).
 _SPAN_CALL = re.compile(r"""\.span\(\s*(['"])([A-Za-z0-9_.:-]+)\1""")
+# Literal first argument of a .counter(/.gauge(/.histogram( call on the
+# telemetry facade or registry. Only the framework namespace is policed:
+# ad-hoc test instruments don't start with fedtpu_.
+_METRIC_CALL = re.compile(
+    r"""\.(?:counter|gauge|histogram)\(\s*(['"])(fedtpu_[A-Za-z0-9_]+)\1"""
+)
 _INLINE_CODE = re.compile(r"`([^`]+)`")
 
 
@@ -46,6 +53,23 @@ def emitted_span_names(package_dir: str = None) -> Dict[str, List[str]]:
     return found
 
 
+def emitted_metric_names(package_dir: str = None) -> Dict[str, List[str]]:
+    """{metric name: [relative file paths emitting it]} over fedtpu/."""
+    package_dir = package_dir or os.path.join(REPO, "fedtpu")
+    found: Dict[str, List[str]] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            for m in _METRIC_CALL.finditer(text):
+                rel = os.path.relpath(path, REPO)
+                found.setdefault(m.group(2), []).append(rel)
+    return found
+
+
 def documented_names(doc_path: str = None) -> Set[str]:
     """Every inline-code token in OBSERVABILITY.md (the span table uses
     `` `name` `` markup; matching the whole doc keeps the check insensitive
@@ -61,7 +85,10 @@ def documented_names(doc_path: str = None) -> Set[str]:
         # A cell like `round` / `fused_rounds` documents both tokens.
         for tok in re.split(r"[\s/|,]+", m.group(1)):
             if tok:
-                names.add(tok.strip())
+                tok = tok.strip()
+                names.add(tok)
+                # `fedtpu_foo{label="x"}` documents the base metric name.
+                names.add(tok.split("{")[0])
     return names
 
 
@@ -79,6 +106,27 @@ def check(package_dir: str = None, doc_path: str = None) -> List[str]:
                 f"span {name!r} (emitted in {', '.join(emitted[name])}) has "
                 "no entry in docs/OBSERVABILITY.md"
             )
+    problems.extend(check_metrics(package_dir, doc_path))
+    return problems
+
+
+def check_metrics(package_dir: str = None, doc_path: str = None) -> List[str]:
+    """Metric-name drift problems (empty = pass)."""
+    emitted = emitted_metric_names(package_dir)
+    documented = documented_names(doc_path)
+    problems = []
+    # Scanner-drift guard only for the real tree: a synthetic package_dir
+    # may legitimately emit spans but no metrics.
+    if not emitted and package_dir is None:
+        problems.append("scanner found NO fedtpu_* metric calls in fedtpu/ "
+                        "— the regex or layout drifted; fix "
+                        "tools/span_check.py")
+    for name in sorted(emitted):
+        if name not in documented:
+            problems.append(
+                f"metric {name!r} (emitted in {', '.join(emitted[name])}) "
+                "has no entry in docs/OBSERVABILITY.md"
+            )
     return problems
 
 
@@ -89,7 +137,8 @@ def main(argv=None) -> int:
             print(f"SPAN DRIFT: {problem}", file=sys.stderr)
         return 1
     n = len(emitted_span_names())
-    print(f"ok: {n} span names emitted, all documented")
+    m = len(emitted_metric_names())
+    print(f"ok: {n} span names + {m} metric names emitted, all documented")
     return 0
 
 

@@ -19,25 +19,35 @@ merges any number of those files into a single Chrome trace where:
   receiving client from the ``fedtpu-trace-bin`` metadata) are resolved
   into ordinary ``args.parent_id`` references — after the merge a client
   ``client_train`` span's parent chain walks through the coordinator's
-  ``client_rpc`` span up to its ``round`` span.
+  ``client_rpc`` span up to its ``round`` span;
+- ``--device-trace DIR`` ingests a ``jax.profiler`` capture (the CLIs'
+  ``--profile-rounds``, fedtpu.obs.profile.CaptureWindow): XLA device-op
+  executions land on extra ``device:*`` lanes — one per chip (TPU) or one
+  for the XLA CPU executor threads — wall-clock aligned with the host
+  spans via the capture's ``profile_meta.json`` sidecar, every event
+  tagged ``cat="device"`` so ``tools/gap_analyze.py`` can separate device
+  busy time from host phases.
 
 Import-free of fedtpu (stdlib only), like the other ``tools/`` readers.
 
 Usage:
     python tools/trace_merge.py primary.json client0.json client1.json \
-        -o merged.json [--check]
+        [--device-trace capture_dir] -o merged.json [--check]
 
 ``--check`` additionally verifies every ``client_train`` span reaches a
-``round`` root through the merged parent chain and exits non-zero
-otherwise (the CI assertion, see tests/test_obs_propagation.py).
+``round`` root through the merged parent chain (and, with
+``--device-trace``, that at least one device lane carries ops) and exits
+non-zero otherwise (the CI assertion, see tests/test_obs_propagation.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
+import os
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 def load_doc(path: str) -> dict:
@@ -55,18 +65,120 @@ def _qualify(role: str, span_id) -> str:
     return f"{role}/{span_id}"
 
 
-def merge_docs(docs: List[dict]) -> dict:
+# ------------------------------------------------------ device-trace input
+PROFILE_META = "profile_meta.json"  # fedtpu.obs.profile sidecar name
+
+
+def find_device_trace(trace_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json[.gz]`` under a ``jax.profiler`` output dir
+    (layout: ``plugins/profile/<run>/<host>.trace.json.gz``)."""
+    hits = []
+    for dirpath, _dirs, files in os.walk(trace_dir):
+        for f in files:
+            if f.endswith(".trace.json.gz") or f.endswith(".trace.json"):
+                hits.append(os.path.join(dirpath, f))
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def _find_sidecar(start_dir: str) -> Optional[dict]:
+    """Walk up from the trace file's dir looking for the capture sidecar
+    (the file sits 2-3 levels below the dir the sidecar was written to)."""
+    d = os.path.abspath(start_dir)
+    for _ in range(4):
+        p = os.path.join(d, PROFILE_META)
+        if os.path.exists(p):
+            try:
+                with open(p) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                return None
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def load_device_trace(path: str) -> dict:
+    """Load a ``jax.profiler`` Chrome trace (dir or file, .gz or plain)
+    plus its ``profile_meta.json`` sidecar. Returns the trace doc with
+    ``metadata.wall_start``/``role`` filled from the sidecar when found
+    (profiler timestamps are relative to the capture open, which is when
+    the sidecar stamps its wall clock)."""
+    if os.path.isdir(path):
+        hit = find_device_trace(path)
+        if hit is None:
+            raise FileNotFoundError(
+                f"no *.trace.json[.gz] under {path} (is this a "
+                "--profile-rounds / jax.profiler output dir?)"
+            )
+        path = hit
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    doc.setdefault("metadata", {})
+    sidecar = _find_sidecar(os.path.dirname(os.path.abspath(path)))
+    if sidecar:
+        doc["metadata"].setdefault("wall_start", sidecar.get("wall_start"))
+        doc["metadata"].setdefault(
+            "role", sidecar.get("role") or "device"
+        )
+    return doc
+
+
+def extract_device_lanes(doc: dict) -> List[Tuple[str, List[dict]]]:
+    """``[(lane_name, X-events)]`` for the device work in a profiler trace.
+
+    TPU/GPU captures name their op lanes ``/device:TPU:0`` etc. in
+    ``process_name`` metadata — one merged lane per chip. CPU captures
+    have no device process; there the XLA executor's op executions run on
+    host threads named ``tf_XLA...``, so when no ``/device:`` lane exists
+    those threads become one synthetic ``XLA:CPU`` lane (real HLO op
+    names, same idle-gap semantics)."""
+    pid_name: Dict[object, str] = {}
+    thread_name: Dict[Tuple[object, object], str] = {}
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pid_name[e.get("pid")] = str(e.get("args", {}).get("name", ""))
+        elif e.get("name") == "thread_name":
+            thread_name[(e.get("pid"), e.get("tid"))] = str(
+                e.get("args", {}).get("name", "")
+            )
+    device_pids = {
+        pid for pid, name in pid_name.items() if "/device:" in name
+    }
+    lanes: Dict[str, List[dict]] = {}
+    if device_pids:
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") == "X" and e.get("pid") in device_pids:
+                lanes.setdefault(pid_name[e["pid"]], []).append(e)
+    else:
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            tname = thread_name.get((e.get("pid"), e.get("tid")), "")
+            if tname.startswith("tf_XLA"):
+                lanes.setdefault("XLA:CPU", []).append(e)
+    return sorted(lanes.items())
+
+
+def merge_docs(docs: List[dict], device_docs: List[dict] = ()) -> dict:
     """Merge loaded trace docs (see module docstring). Order fixes lane
     numbering; roles are deduplicated with a ``#n`` suffix if two files
     claim the same one."""
     merged: List[dict] = []
     seen_roles: Dict[str, int] = {}
     roles: List[str] = []
+    device_lanes: List[str] = []
     trace_ids = []
     unaligned = []
     wall_starts = [
         d["metadata"].get("wall_start")
-        for d in docs
+        for d in list(docs) + list(device_docs)
         if d["metadata"].get("wall_start") is not None
     ]
     base_wall = min(wall_starts) if wall_starts else None
@@ -116,11 +228,43 @@ def merge_docs(docs: List[dict]) -> dict:
             ev["args"] = args
             merged.append(ev)
 
+    # Device lanes ride after the host lanes: one pid per chip (or the
+    # synthetic XLA:CPU executor lane), events tagged cat="device" so
+    # downstream readers (gap_analyze) can tell device busy time from
+    # host spans without name heuristics.
+    lane = len(docs)
+    for doc in device_docs:
+        meta = doc["metadata"]
+        role = str(meta.get("role") or "device")
+        offset_us = 0.0
+        if base_wall is not None and meta.get("wall_start") is not None:
+            offset_us = (meta["wall_start"] - base_wall) * 1e6
+        elif base_wall is not None:
+            unaligned.append(f"device:{role}")
+        for lane_name, events in extract_device_lanes(doc):
+            lane += 1
+            full = f"device:{lane_name} ({role})"
+            device_lanes.append(full)
+            merged.append({
+                "name": "process_name",
+                "ph": "M",
+                "pid": lane,
+                "args": {"name": full},
+            })
+            for event in events:
+                ev = dict(event)
+                ev["pid"] = lane
+                ev["cat"] = "device"
+                if "ts" in ev:
+                    ev["ts"] = round(ev["ts"] + offset_us, 3)
+                merged.append(ev)
+
     return {
         "traceEvents": merged,
         "displayTimeUnit": "ms",
         "metadata": {
             "merged_roles": roles,
+            "device_lanes": device_lanes,
             "trace_ids": trace_ids,
             "unaligned": unaligned,
         },
@@ -182,23 +326,44 @@ def main(argv=None) -> int:
                    help="per-process Chrome-trace JSON dumps (put the "
                    "coordinator's first for lane ordering)")
     p.add_argument("-o", "--out", required=True, help="merged trace path")
+    p.add_argument(
+        "--device-trace", action="append", default=[], metavar="DIR",
+        help="ingest a jax.profiler capture (--profile-rounds output dir "
+        "or a *.trace.json[.gz] file) as wall-clock-aligned device lanes; "
+        "repeatable",
+    )
     p.add_argument("--check", action="store_true",
                    help="fail unless every client_train span roots in a "
-                   "round span through the merged parent chain")
+                   "round span through the merged parent chain (and any "
+                   "--device-trace contributed at least one device op)")
     args = p.parse_args(argv)
 
-    doc = merge_docs([load_doc(path) for path in args.traces])
+    doc = merge_docs(
+        [load_doc(path) for path in args.traces],
+        device_docs=[load_device_trace(p) for p in args.device_trace],
+    )
     with open(args.out, "w") as fh:
         json.dump(doc, fh)
     n = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    n_dev = sum(
+        1 for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e.get("cat") == "device"
+    )
     print(
-        f"merged {len(args.traces)} traces -> {args.out}: {n} spans, "
-        f"lanes {doc['metadata']['merged_roles']}, "
+        f"merged {len(args.traces)} traces -> {args.out}: {n} spans "
+        f"({n_dev} device ops), "
+        f"lanes {doc['metadata']['merged_roles']}"
+        f"{' + ' + str(doc['metadata']['device_lanes']) if doc['metadata']['device_lanes'] else ''}, "
         f"trace_ids {doc['metadata']['trace_ids']}",
         file=sys.stderr,
     )
     if args.check:
         problems = check_client_train_nesting(doc)
+        if args.device_trace and n_dev == 0:
+            problems.append(
+                "device traces given but no device ops made it into the "
+                "merge (empty capture window?)"
+            )
         if doc["metadata"]["unaligned"]:
             problems.append(
                 f"unaligned files (no wall_start): "

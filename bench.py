@@ -1053,6 +1053,149 @@ def _chaos_overhead_microbench():
     return result
 
 
+def _fencing_overhead_microbench():
+    """``fencing_overhead``: what coordinator-epoch fencing costs per round
+    — the epoch a coordinator injects into every outbound RPC plus the
+    receiver-side fence validation (decode the epoch back out, compare it
+    against the max seen under a lock, adopt or reject; mirrors
+    ``ClientAgent._fence_check``). Fencing is the split-brain eliminator
+    (docs/FAULT_TOLERANCE.md §Fencing); it runs on EVERY StartTrain /
+    SendModel / replica push / liveness ping, so it must be free on the
+    steady-state path.
+
+    Same two-measurement methodology as ``--chaos-overhead-microbench``:
+
+    - **Attributable cost** (the headline ``value``): the exact per-RPC
+      inject+validate — encode an epoch-bearing request, decode it,
+      locked compare-and-adopt — timed directly in a tight loop and
+      scaled by the per-round RPC multiplicity (StartTrain + SendModel
+      per client, plus the backup ping and the replica push) over the
+      bare round wall of a densenet_cifar CPU round. Deliberately an
+      over-count: the whole encode/decode is charged to fencing, not
+      just the marginal two varint fields. Acceptance gate: <= 1%
+      (``gate_pct`` / ``passes_gate``).
+    - **A/B walls (audit)**: the same compiled engine driven with and
+      without the per-round inject+validate sequence bolted on, mode
+      order rotated per rep, medians next to the bare trials' spread
+      (``noise_floor_pct``).
+
+    Run via ``python bench.py --fencing-overhead-microbench``; prints one
+    JSON line and writes ``artifacts/FENCING_MICROBENCH.json``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import threading
+
+    import numpy as np
+
+    from fedtpu.config import DataConfig, FedConfig, RoundConfig
+    from fedtpu.core.engine import Federation
+    from fedtpu.transport import proto
+
+    model_name = os.environ.get("FEDTPU_FE_MODEL", "densenet_cifar")
+    clients = int(os.environ.get("FEDTPU_FE_CLIENTS", "2"))
+    rounds = int(os.environ.get("FEDTPU_FE_ROUNDS", "3"))
+    reps = int(os.environ.get("FEDTPU_FE_REPS", "5"))
+    batch = int(os.environ.get("FEDTPU_FE_BATCH", "8"))
+
+    cfg = RoundConfig(
+        model=model_name,
+        num_classes=10,
+        data=DataConfig(
+            dataset="cifar10", batch_size=batch, partition="iid",
+            num_examples=clients * batch * 4,
+        ),
+        fed=FedConfig(num_clients=clients, telemetry="off"),
+        steps_per_round=1,
+    )
+    fed = Federation(cfg, seed=0)
+
+    # Receiver-side fence state, mirroring ClientAgent._fence_check: max
+    # epoch seen, updated/compared under a lock on every validation.
+    fence_lock = threading.Lock()
+    epoch_seen = [41]
+
+    def fence_rpc(epoch: int) -> bool:
+        # Sender side: inject the epoch into the request bytes; receiver
+        # side: decode it back out and run the locked fence compare.
+        wire = proto.TrainRequest(
+            rank=1, world=clients, round=7, epoch=epoch
+        ).encode()
+        req = proto.TrainRequest.decode(wire)
+        with fence_lock:
+            if req.epoch >= epoch_seen[0]:
+                epoch_seen[0] = req.epoch
+                return True
+        return False
+
+    # StartTrain + SendModel per client, plus the backup liveness ping and
+    # the replica push — every fenced RPC a synchronous round issues.
+    rpcs_per_round = clients * 2 + 2
+
+    def fencing_round_sequence(r: int) -> None:
+        for _ in range(rpcs_per_round):
+            fence_rpc(42)
+
+    def run_block(with_fencing: bool):
+        for r in range(rounds):
+            if with_fencing:
+                fencing_round_sequence(r)
+            m = fed.step()
+        np.asarray(m.loss)  # honest sync point (OPERATIONS rule 4)
+
+    run_block(False)  # compile + warmup
+    modes = ("bare", "fenced")
+    trials = {mode: [] for mode in modes}
+    for rep in range(reps):
+        for mode in modes if rep % 2 == 0 else modes[::-1]:
+            t0 = time.perf_counter()
+            run_block(mode == "fenced")
+            trials[mode].append((time.perf_counter() - t0) / rounds)
+    med = {mode: sorted(ts)[len(ts) // 2] for mode, ts in trials.items()}
+    ab_delta_pct = (med["fenced"] - med["bare"]) / med["bare"] * 100.0
+    noise_floor_pct = (
+        (max(trials["bare"]) - min(trials["bare"])) / med["bare"] * 100.0
+    )
+
+    # Attributable cost: direct timing of the exact per-RPC op.
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fence_rpc(42)
+    inject_validate_us = (time.perf_counter() - t0) / n * 1e6
+    per_round_us = rpcs_per_round * inject_validate_us
+    attributable_pct = per_round_us / (med["bare"] * 1e6) * 100.0
+
+    result = {
+        "metric": "fencing_overhead",
+        "unit": "% of round wall time attributable to the per-RPC "
+                "coordinator-epoch inject + fence validation",
+        "value": round(attributable_pct, 6),
+        "gate_pct": 1.0,
+        "passes_gate": bool(attributable_pct <= 1.0),
+        "per_rpc_us": {"inject_validate": round(inject_validate_us, 3)},
+        "rpcs_per_round": rpcs_per_round,
+        "per_round_fencing_us": round(per_round_us, 3),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "noise_floor_pct": round(noise_floor_pct, 3),
+        "round_ms": {mode: round(t * 1e3, 3) for mode, t in med.items()},
+        "model": model_name,
+        "num_clients": clients,
+        "rounds_per_trial": rounds,
+        "reps": reps,
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "FENCING_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 def _checkpoint_overhead_microbench():
     """``checkpoint_overhead``: what per-round durable checkpointing costs
     the ROUND LOOP under the background writer
@@ -1775,6 +1918,9 @@ def main():
         return
     if "--screening-overhead-microbench" in sys.argv:
         print(json.dumps(_screening_overhead_microbench()))
+        return
+    if "--fencing-overhead-microbench" in sys.argv:
+        print(json.dumps(_fencing_overhead_microbench()))
         return
     if "--checkpoint-overhead-microbench" in sys.argv:
         print(json.dumps(_checkpoint_overhead_microbench()))

@@ -769,9 +769,11 @@ def install_compile_watcher(telemetry=None, flight=None):
         return None
 
 
-def start_obs_server(args, registry=None, status_fn=None, flight=None):
+def start_obs_server(args, registry=None, status_fn=None, flight=None,
+                     health_fn=None):
     """Honor --obs-port: start (and return) the endpoint, or None when the
-    flag is absent. The caller owns stop()."""
+    flag is absent. The caller owns stop(). ``health_fn`` (() -> (ok,
+    reason)) makes /healthz honest — 503 while fenced or quorum is unmet."""
     import logging
 
     port = getattr(args, "obs_port", None)
@@ -780,7 +782,8 @@ def start_obs_server(args, registry=None, status_fn=None, flight=None):
     from fedtpu.obs import ObsServer
 
     obs = ObsServer(
-        port=port, registry=registry, status_fn=status_fn, flight=flight
+        port=port, registry=registry, status_fn=status_fn, flight=flight,
+        health_fn=health_fn,
     ).start()
     logging.info(
         "obs endpoint on %s (/metrics /healthz /statusz /flightz)", obs.url

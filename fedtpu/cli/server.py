@@ -177,6 +177,7 @@ def main(argv=None) -> int:
             registry=primary.telemetry.registry,
             status_fn=primary.status_snapshot,
             flight=flight,
+            health_fn=primary.health,
         )
         if args.gate:
             primary.start_gate(args.gate)
@@ -244,6 +245,7 @@ def main(argv=None) -> int:
         registry=backup.telemetry.registry,
         status_fn=backup.status_snapshot,
         flight=flight,
+        health_fn=backup.health,
     )
     logging.info("backup serving on %s", args.listen)
     try:

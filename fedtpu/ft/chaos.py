@@ -23,6 +23,19 @@ Fault kinds:
 - ``kill``    — SIGKILL the *current process* (use ``max=1`` for the
   one-shot mid-round primary kill of the failover drills).
 
+Network-partition faults (``NET_KINDS``: ``partition`` | ``flaky``) ride
+the same wire interceptors but model LINK failures rather than peer
+failures: ``partition`` is a total link cut (immediate UNAVAILABLE, no
+time spent — the TCP RST of a severed path), group-keyed via ``peer=a|b``
+so one rule severs a whole side of the federation, and windowed either by
+``rounds=`` or the new wall-clock ``window=lo-hi`` (seconds since the
+schedule was armed — partitions must also cut paths, like the backup
+watchdog's, that never learn a round number); ``flaky`` is the gray link —
+a seeded intermittent burst that *delays* ``delay_s`` and then fails with
+``code``, the flapping half-failure that exercises watchdog hysteresis.
+Asymmetric cuts fall out of placement: arm ``partition`` only on one
+side's schedule and the reverse direction stays up.
+
 Model-level Byzantine attacks (``ATTACK_KINDS``: ``sign_flip`` |
 ``scale:factor=F`` | ``noise:std=S[,collude=1]`` | ``label_flip:offset=K``)
 ride the same schedule/DSL but are a separate fault CLASS: they are
@@ -53,7 +66,10 @@ or the mini-DSL ``kind@rpc:key=val,...`` with rules joined by ``;`` —
 e.g. ``error@StartTrain:p=0.3,seed=7;delay@SendModel:p=0.1,delay=0.5``.
 Keys: ``p`` (probability), ``peer``, ``delay`` (seconds), ``code``
 (grpc status name), ``rounds`` (``lo-hi`` half-open window or a single
-round), ``max`` (total injection cap), ``consec`` (max consecutive fires
+round), ``window`` (``lo-hi`` half-open wall-clock window in seconds since
+the schedule was armed — the time-domain sibling of ``rounds`` for paths
+with no round counter), ``max`` (total injection cap), ``consec`` (max
+consecutive fires
 per stream — what makes a rule transient BY CONSTRUCTION; pair
 ``consec < retry attempts`` with unbounded ``p`` faults), ``seed``
 (schedule-wide).
@@ -101,7 +117,16 @@ DISK_KINDS = ("ckpt_fail", "ckpt_torn", "ckpt_rot")
 # training labels by `offset` classes. The simulated twin is
 # fedtpu.sim.adversary (SimConfig.malicious_fraction).
 ATTACK_KINDS = ("sign_flip", "scale", "noise", "label_flip")
-KINDS = WIRE_KINDS + ATTACK_KINDS + DISK_KINDS
+# Link-level network faults (the partition/gray-failure class): fired by
+# the SAME wire interceptors as WIRE_KINDS but modeling the link, not the
+# peer. "partition" severs the path instantly (UNAVAILABLE with no sleep);
+# "flaky" stalls delay_s then fails with `code` — the gray link that flaps
+# watchdogs. Group-keyed peers (peer=a|b) and wall-clock windows
+# (window=lo-hi seconds) let one rule cut a whole side of the federation
+# for a bounded interval. The partition-heal soak
+# (tools/chaos_soak.py --partition) is built on these.
+NET_KINDS = ("partition", "flaky")
+KINDS = WIRE_KINDS + NET_KINDS + ATTACK_KINDS + DISK_KINDS
 # The service's RPC surface plus the engine loops' pseudo-RPC, the
 # model-level attack consult, and the checkpoint store's disk consult.
 RPC_NAMES = (
@@ -124,6 +149,12 @@ class FaultRule:
     # Half-open [lo, hi) coordinator-round window; None = every round.
     # Only consulted where a round is known (the coordinator sets it).
     rounds: Optional[Tuple[int, int]] = None
+    # Half-open [lo, hi) WALL-CLOCK window in seconds since the schedule
+    # was constructed; None = always. The time-domain sibling of rounds=,
+    # for paths that never learn a round number (the backup's watchdog
+    # probes, a partitioned primary whose round counter stalls) — a healed
+    # partition is "the window closed".
+    window: Optional[Tuple[float, float]] = None
     # Total injections this rule may ever perform (None = unbounded);
     # max=1 is the one-shot process kill.
     max_injections: Optional[int] = None
@@ -154,6 +185,10 @@ class FaultRule:
     def is_disk(self) -> bool:
         return self.kind in DISK_KINDS
 
+    @property
+    def is_net(self) -> bool:
+        return self.kind in NET_KINDS
+
     def validate(self) -> "FaultRule":
         if self.kind not in KINDS:
             raise ValueError(
@@ -175,11 +210,24 @@ class FaultRule:
                 "store, not an RPC — leave rpc unset (it keys on the "
                 "pseudo-RPC 'Disk')"
             )
-        if self.kind in WIRE_KINDS and self.rpc in ("Attack", "Disk"):
+        if (self.kind in WIRE_KINDS + NET_KINDS
+                and self.rpc in ("Attack", "Disk")):
             raise ValueError(
                 f"wire kind {self.kind!r} cannot target the pseudo-RPC "
                 f"{self.rpc!r} (kind classes never cross)"
             )
+        if self.is_net and self.rpc == "Round":
+            raise ValueError(
+                f"net kind {self.kind!r} models a LINK fault — it needs a "
+                "wire RPC, not the engine-loop pseudo-RPC 'Round'"
+            )
+        if self.window is not None:
+            lo, hi = self.window
+            if lo < 0 or hi <= lo:
+                raise ValueError(
+                    f"fault window must satisfy 0 <= lo < hi, got "
+                    f"{lo}-{hi}"
+                )
         if self.kind == "scale" and self.factor == 0.0:
             raise ValueError("scale attack factor must be nonzero")
         if self.noise_std < 0:
@@ -213,6 +261,8 @@ class FaultSchedule:
         self._streak: Dict[Tuple[int, str, str], int] = {}
         self._fired = [0] * len(self.rules)
         self._round: Optional[int] = None
+        # Arm time: origin of the window= wall-clock axis.
+        self._t0 = time.monotonic()
         self._lock = threading.Lock()
         self._metrics = None
         self._flight = None
@@ -243,11 +293,17 @@ class FaultSchedule:
             return False
         if rule.rpc != "*" and rule.rpc != rpc:
             return False
-        if rule.peer != "*" and rule.peer != peer:
+        # peer may be a |-joined GROUP (partition rules cut whole sides of
+        # the federation with one rule); a single peer is a group of one.
+        if rule.peer != "*" and peer not in rule.peer.split("|"):
             return False
         if rule.rounds is not None and self._round is not None:
             lo, hi = rule.rounds
             if not lo <= self._round < hi:
+                return False
+        if rule.window is not None:
+            lo, hi = rule.window
+            if not lo <= time.monotonic() - self._t0 < hi:
                 return False
         return True
 
@@ -332,6 +388,8 @@ class FaultSchedule:
                 opts.append(f"peer={r.peer}")
             if r.rounds is not None:
                 opts.append(f"rounds={r.rounds[0]}-{r.rounds[1]}")
+            if r.window is not None:
+                opts.append(f"window={r.window[0]:g}-{r.window[1]:g}")
             if r.max_injections is not None:
                 opts.append(f"max={r.max_injections}")
             if r.max_consecutive is not None:
@@ -373,6 +431,17 @@ class FaultSchedule:
         elif rule.kind == "error":
             raise ChaosRpcError(getattr(grpc.StatusCode, rule.code),
                                 "chaos: injected error")
+        elif rule.kind == "partition":
+            # A severed link fails FAST (connection refused / RST), unlike
+            # drop's time-compressed blackhole — no sleep.
+            raise ChaosRpcError(grpc.StatusCode.UNAVAILABLE,
+                                "chaos: partitioned link")
+        elif rule.kind == "flaky":
+            # Gray link: a stall long enough to flap watchdogs, then a
+            # failure with the configured code.
+            time.sleep(rule.delay_s)
+            raise ChaosRpcError(getattr(grpc.StatusCode, rule.code),
+                                "chaos: flaky link")
         elif rule.kind == "kill":
             self._kill(rpc)
 
@@ -502,6 +571,17 @@ class FaultSchedule:
                                 getattr(grpc.StatusCode, rule.code),
                                 "chaos: injected error",
                             )
+                        elif rule.kind == "partition":
+                            context.abort(
+                                grpc.StatusCode.UNAVAILABLE,
+                                "chaos: partitioned link",
+                            )
+                        elif rule.kind == "flaky":
+                            time.sleep(rule.delay_s)
+                            context.abort(
+                                getattr(grpc.StatusCode, rule.code),
+                                "chaos: flaky link",
+                            )
                         elif rule.kind == "kill":
                             schedule._kill(rpc)
                     response = inner(request, context)
@@ -607,7 +687,7 @@ def _parse_dsl(spec: str) -> FaultSchedule:
             val = val.strip()
             if key == "seed":
                 seed = int(val)
-            elif key in ("p", "peer", "code", "rounds"):
+            elif key in ("p", "peer", "code", "rounds", "window"):
                 fields[key] = val
             elif key == "delay":
                 fields["delay_s"] = val
@@ -626,7 +706,7 @@ def _parse_dsl(spec: str) -> FaultSchedule:
             else:
                 raise ValueError(
                     f"unknown chaos option {key!r} in {part!r}; have "
-                    "p|peer|delay|code|rounds|max|consec|seed|"
+                    "p|peer|delay|code|rounds|window|max|consec|seed|"
                     "factor|std|offset|collude"
                 )
         rules.append(_rule_from(fields))
@@ -650,6 +730,17 @@ def _rule_from(fields: dict) -> FaultRule:
         )
     if "rounds" in fields and fields["rounds"] is not None:
         fields["rounds"] = tuple(int(x) for x in fields["rounds"])
+    if "window" in fields and not isinstance(fields["window"],
+                                             (tuple, list)):
+        lo, dash, hi = str(fields["window"]).partition("-")
+        if not dash:
+            raise ValueError(
+                f"chaos window must be lo-hi seconds, got "
+                f"{fields['window']!r}"
+            )
+        fields["window"] = (float(lo), float(hi))
+    if "window" in fields and fields["window"] is not None:
+        fields["window"] = tuple(float(x) for x in fields["window"])
     for key in ("p", "delay_s", "factor", "noise_std"):
         if key in fields:
             fields[key] = float(fields[key])

@@ -8,7 +8,11 @@ bind by default):
   Prometheus text exposition format, rendered from one
   ``registry.snapshot()`` per request (each scrape is a consistent
   point-in-time view; scraping mid-round is safe and tested);
-- ``/healthz``  — 200 ``ok`` while the process is serving;
+- ``/healthz``  — 200 ``ok`` while the process is HEALTHY; 503 with a
+  one-line reason while it is not (a fenced coordinator pending re-base,
+  quorum unmet) — honest enough for an orchestrator probe to act on,
+  via an injected ``health_fn`` (no ``health_fn`` keeps the legacy
+  unconditional 200);
 - ``/statusz``  — JSON from an injected ``status_fn`` (the owning
   component's :meth:`status_snapshot`: current round + phase, client
   liveness, failover role, heartbeat misses, last-round phase timings —
@@ -69,7 +73,16 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/healthz":
-                self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                health_fn = self.server.obs_health_fn
+                if health_fn is None:
+                    self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                else:
+                    ok, reason = health_fn()
+                    self._send(
+                        200 if ok else 503,
+                        (reason + "\n").encode(),
+                        "text/plain; charset=utf-8",
+                    )
             elif path == "/metrics":
                 registry = self.server.obs_registry
                 if registry is None:
@@ -120,12 +133,17 @@ class ObsServer:
         registry=None,
         status_fn: Optional[Callable[[], dict]] = None,
         flight=None,
+        health_fn: Optional[Callable[[], tuple]] = None,
     ):
+        """``health_fn``: () -> (ok, reason) — the owning component's
+        honest liveness verdict (e.g. ``PrimaryServer.health``); None
+        keeps the legacy unconditional 200."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.obs_registry = registry
         self._httpd.obs_status_fn = status_fn
         self._httpd.obs_flight = flight
+        self._httpd.obs_health_fn = health_fn
         self._thread: Optional[threading.Thread] = None
 
     @property

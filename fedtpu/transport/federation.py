@@ -28,6 +28,7 @@ import json
 import logging
 import math
 import os
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -66,7 +67,7 @@ from fedtpu.obs import (
 from fedtpu.obs import propagate
 from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
-from fedtpu.transport.retry import call_with_retry
+from fedtpu.transport.retry import call_with_retry, is_stale_coordinator
 from fedtpu.transport.service import (
     TrainerServicer,
     TrainerStub,
@@ -525,8 +526,42 @@ class ClientAgent(TrainerServicer):
                  state_dir: Optional[str] = None):
         self.trainer = LocalTrainer(cfg, seed=seed, state_dir=state_dir)
         self.last_eval: Optional[Tuple[float, float]] = None
+        # Coordinator fencing (docs/FAULT_TOLERANCE.md §Fencing): the max
+        # coordinator epoch this client has ever seen. A coordinator-
+        # originated RPC carrying a LOWER epoch comes from a superseded
+        # primary (a healed partition's stale side) and is rejected with a
+        # typed STALE_COORDINATOR status — accepting it would fork the
+        # lineage. -1 until any epoch-carrying peer speaks (pre-fencing
+        # coordinators never advertise one and are never rejected).
+        self._max_epoch = -1
+        self._epoch_lock = threading.Lock()
+
+    def _fence_check(self, epoch: int, rpc: str, context) -> None:
+        """Track the max coordinator epoch; abort a stale sender. Aborting
+        raises, so callers just invoke this first."""
+        if epoch < 0:
+            return  # pre-fencing peer: no epoch advertised
+        with self._epoch_lock:
+            if epoch >= self._max_epoch:
+                self._max_epoch = epoch
+                return
+            newest = self._max_epoch
+        log.warning(
+            "%s from stale coordinator epoch %d rejected (newest seen %d)",
+            rpc, epoch, newest,
+        )
+        self.trainer.telemetry.counter(
+            "fedtpu_ft_stale_rejected_total",
+            "coordinator RPCs rejected for a stale fencing epoch, by rpc",
+            labels={"rpc": rpc},
+        ).inc()
+        context.abort(
+            grpc.StatusCode.FAILED_PRECONDITION,
+            f"STALE_COORDINATOR: epoch {epoch} < {newest}",
+        )
 
     def StartTrain(self, request: proto.TrainRequest, context) -> proto.TrainReply:
+        self._fence_check(request.epoch, "StartTrain", context)
         payload = self.trainer.train_round(
             request.rank, request.world,
             trace_ctx=trace_context_of(context),
@@ -535,6 +570,7 @@ class ClientAgent(TrainerServicer):
         return proto.TrainReply(message=payload)
 
     def SendModel(self, request: proto.SendModelRequest, context) -> proto.SendModelReply:
+        self._fence_check(request.epoch, "SendModel", context)
         self.trainer.set_global(
             request.model, trace_ctx=trace_context_of(context)
         )
@@ -746,6 +782,34 @@ class PrimaryServer:
         # replays earlier rounds' PRNG draws. len(self.history) cannot
         # serve: history restarts at 0 in every new server process.
         self._round_counter = 0
+        # --- Coordinator fencing (docs/FAULT_TOLERANCE.md §Fencing) ------
+        # role: 1 = configured primary, 2 = acting (promoted backup) — rides
+        # on SendModelRequest.role so receivers/flight can attribute the
+        # sender without decoding the payload. epoch: minted monotonically
+        # on every promotion or post-fence re-base; replicated in the
+        # replica payload and persisted in the checkpoint template ladder,
+        # so a lineage's epoch survives restarts. _fenced flips when any
+        # receiver rejects us with STALE_COORDINATOR — the round loop then
+        # voids the in-flight round and re-bases (handle_fence).
+        # _epoch_seen: the largest epoch any rejection has told us about,
+        # so the re-base mints PAST the winner even if the backup is
+        # unreachable during the heal.
+        self._role = 1
+        self._fenced = False
+        self._epoch_seen = -1
+        self._fence_lock = threading.Lock()
+        # Pacing between re-base attempts while the winning lineage is
+        # still unreachable (handle_fence keeps the fence up until the
+        # recovering handshake actually lands).
+        self._fence_retry_s = 0.5
+        self._set_epoch(1)
+        # Seeded retry jitter: when chaos is armed, backoff jitter draws
+        # from a schedule-seeded stream instead of the global random, so a
+        # soak's retry timing replays deterministically under one seed.
+        self._retry_rand = (
+            random.Random(chaos.seed ^ 0xFE17CE).random
+            if chaos is not None else None
+        )
 
         _metrics = self.telemetry.registry if self.telemetry.enabled else None
         if chaos is not None:
@@ -966,28 +1030,43 @@ class PrimaryServer:
             compress=self.compress,
         )
 
+    def _set_epoch(self, epoch: int) -> None:
+        """Adopt a coordinator epoch and mirror it on the gauge — one path
+        for mint (promotion / post-fence re-base) and restore (replica /
+        checkpoint), so the observable epoch can never lag the wire one."""
+        self._coord_epoch = int(epoch)
+        self.telemetry.gauge(
+            "fedtpu_ft_coordinator_epoch",
+            "this coordinator's fencing epoch (minted on promotion or "
+            "post-fence re-base)",
+        ).set(float(self._coord_epoch))
+
     def state_tree(self) -> dict:
         """Full resumable server state as one pytree: the model, the
-        monotonic round counter, the membership roster (as a JSON uint8
-        leaf — variable-length, so a growing federation still replicates),
-        and (when a server optimizer is configured) its moments. This is
-        both the replica payload body and the checkpoint state — one
-        format, so failover and resume can never drift apart."""
+        monotonic round counter, the coordinator fencing epoch, the
+        membership roster (as a JSON uint8 leaf — variable-length, so a
+        growing federation still replicates), and (when a server optimizer
+        is configured) its moments. This is both the replica payload body
+        and the checkpoint state — one format, so failover and resume can
+        never drift apart."""
         tree = {
             "params": self.params,
             "batch_stats": self.batch_stats,
             "round_counter": np.asarray(self._round_counter, np.int64),
+            "coord_epoch": np.asarray(self._coord_epoch, np.int64),
             "membership": self._membership_bytes(),
         }
         if self._server_opt is not None:
             tree["server_opt"] = self._server_opt_state
         return tree
 
-    def state_template(self, membership: bool = True) -> dict:
+    def state_template(self, membership: bool = True,
+                       epoch: bool = True) -> dict:
         """Decode template matching :meth:`state_tree`'s structure.
-        ``membership=False`` yields the pre-elastic-membership layout, so
-        replicas/checkpoints written by older coordinators still restore
-        (with the startup roster kept)."""
+        ``membership=False`` yields the pre-elastic-membership layout and
+        ``epoch=False`` the pre-fencing one, so replicas/checkpoints
+        written by older coordinators still restore (with the startup
+        roster / current epoch kept)."""
         from fedtpu.core import server_opt as server_opt_lib
 
         params, stats = _model_template(self.model, self.cfg)
@@ -996,6 +1075,8 @@ class PrimaryServer:
             "batch_stats": stats,
             "round_counter": np.zeros((), np.int64),
         }
+        if epoch:
+            tree["coord_epoch"] = np.zeros((), np.int64)
         if membership:
             tree["membership"] = np.zeros((0,), np.uint8)
         if self._server_opt is not None:
@@ -1006,7 +1087,9 @@ class PrimaryServer:
         """Adopt a restored :meth:`state_tree` (from replica or checkpoint).
         When the tree carries a membership roster, the CURRENT roster — not
         the startup list — is adopted with it (failover inherits joins,
-        leaves, and alive flags)."""
+        leaves, and alive flags). The fencing epoch adopts by MAX: a
+        replica can only raise our epoch, never demote us below one we
+        already minted."""
         self._round_counter = int(tree["round_counter"])
         if self._server_opt is not None:
             self._server_opt_state = jax.tree.map(
@@ -1014,6 +1097,8 @@ class PrimaryServer:
             )
         self.params = jax.tree.map(jnp.asarray, tree["params"])
         self.batch_stats = jax.tree.map(jnp.asarray, tree["batch_stats"])
+        if "coord_epoch" in tree:
+            self._set_epoch(max(self._coord_epoch, int(tree["coord_epoch"])))
         if "membership" in tree:
             self._adopt_membership(tree["membership"])
 
@@ -1032,26 +1117,32 @@ class PrimaryServer:
         surviving client through the existing ``sync_clients``/seat-resync
         path — no client re-registers, nothing is lost from the roster.
 
-        Template ladder: current layout -> pre-elastic-membership layout
-        (startup roster kept) -> legacy model-only checkpoints (counter
-        estimated from the generation index). Returns the next round index
-        to run (``start_round``), or None for an empty directory (fresh
-        start). Raises :class:`wire.WireError` when generations exist but
-        none verifies — a disaster the operator must see, never a silent
-        restart from round 0."""
+        Template ladder: current layout -> pre-fencing layout (epoch kept)
+        -> pre-elastic-membership layout (startup roster kept) -> legacy
+        model-only checkpoints (counter estimated from the generation
+        index). Returns the next round index to run (``start_round``), or
+        None for an empty directory (fresh start). Raises
+        :class:`wire.WireError` when generations exist but none verifies —
+        a disaster the operator must see, never a silent restart from
+        round 0."""
         try:
             latest = ckpt.restore_latest(self.state_template())
         except wire.WireError:
             raise
         except ValueError:
             try:
-                latest = ckpt.restore_latest(
-                    self.state_template(membership=False)
-                )
+                latest = ckpt.restore_latest(self.state_template(epoch=False))
             except wire.WireError:
                 raise
             except ValueError:
-                latest = None
+                try:
+                    latest = ckpt.restore_latest(
+                        self.state_template(membership=False, epoch=False)
+                    )
+                except wire.WireError:
+                    raise
+                except ValueError:
+                    latest = None
         if latest is None:
             params, stats = _model_template(self.model, self.cfg)
             legacy = ckpt.restore_latest(
@@ -1109,21 +1200,30 @@ class PrimaryServer:
             except wire.WireError:
                 raise
             except ValueError:
-                # Pre-membership replica (an older coordinator's): decode
-                # under the legacy layout and keep the startup roster. Any
-                # OTHER mismatch fails this template too and raises below.
+                # Older coordinator's replica: try the pre-fencing layout
+                # (epoch kept), then the pre-membership one (startup roster
+                # kept). Any OTHER mismatch fails every template and raises
+                # below.
                 try:
                     tree = wire.decode(
-                        data, self.state_template(membership=False)
+                        data, self.state_template(epoch=False)
                     )
                 except wire.WireError:
                     raise
-                except ValueError as exc:
-                    raise wire.WireError(
-                        "replica payload does not match this server's "
-                        f"configuration ({exc}); refusing to install a "
-                        "partial state"
-                    ) from exc
+                except ValueError:
+                    try:
+                        tree = wire.decode(
+                            data,
+                            self.state_template(membership=False, epoch=False),
+                        )
+                    except wire.WireError:
+                        raise
+                    except ValueError as exc:
+                        raise wire.WireError(
+                            "replica payload does not match this server's "
+                            f"configuration ({exc}); refusing to install a "
+                            "partial state"
+                        ) from exc
             self.install_state(tree)
         else:
             params, stats = _model_template(self.model, self.cfg)
@@ -1160,14 +1260,23 @@ class PrimaryServer:
             raise RuntimeError(f"{client} evicted; nothing to resync")
         # A transient blip mid-resync retries here instead of bouncing the
         # client back to dead for another full heartbeat cycle.
-        call_with_retry(
-            self.retry_policy, "SendModel",
-            lambda: stub.SendModel(
-                proto.SendModelRequest(model=self.model_bytes()),
-                timeout=self._deadlines["SendModel"],
-            ),
-            peer=client, telemetry=self.telemetry,
-        )
+        try:
+            call_with_retry(
+                self.retry_policy, "SendModel",
+                lambda: stub.SendModel(
+                    proto.SendModelRequest(
+                        model=self.model_bytes(),
+                        epoch=self._coord_epoch, role=self._role,
+                    ),
+                    timeout=self._deadlines["SendModel"],
+                ),
+                peer=client, telemetry=self.telemetry,
+                rand=self._retry_rand,
+            )
+        except grpc.RpcError as e:
+            if is_stale_coordinator(e):
+                self._handle_stale("SendModel", client, e)
+            raise
 
     def sync_clients(self) -> None:
         """Broadcast the current global model to all active clients.
@@ -1187,12 +1296,22 @@ class PrimaryServer:
                 call_with_retry(
                     self.retry_policy, "SendModel",
                     lambda s=stub: s.SendModel(
-                        proto.SendModelRequest(model=payload),
+                        proto.SendModelRequest(
+                            model=payload,
+                            epoch=self._coord_epoch, role=self._role,
+                        ),
                         timeout=self._deadlines["SendModel"],
                     ),
                     peer=client, telemetry=self.telemetry,
+                    rand=self._retry_rand,
                 )
-            except grpc.RpcError:
+            except grpc.RpcError as e:
+                if is_stale_coordinator(e):
+                    # We are the superseded side of a healed partition —
+                    # the client is NOT failed; WE must re-base. Leave the
+                    # client alive and let the round loop fence us.
+                    self._handle_stale("SendModel", client, e)
+                    continue
                 log.warning("client %s failed during initial sync", client)
                 self.telemetry.counter(
                     "fedtpu_rpc_failures_total",
@@ -1207,12 +1326,20 @@ class PrimaryServer:
             resp = call_with_retry(
                 self.retry_policy, "CheckIfPrimaryUp",
                 lambda: self.backup_stub.CheckIfPrimaryUp(
-                    proto.PingRequest(req=b"1" if recovering else b"0"),
+                    proto.PingRequest(
+                        req=b"1" if recovering else b"0",
+                        epoch=self._coord_epoch,
+                    ),
                     timeout=self._deadlines["CheckIfPrimaryUp"],
                 ),
                 telemetry=self.telemetry,
+                rand=self._retry_rand,
             )
-        except grpc.RpcError:
+        except grpc.RpcError as e:
+            if is_stale_coordinator(e):
+                # The backup promoted past us while we were partitioned;
+                # our liveness probe may no longer reset its watchdog.
+                self._handle_stale("CheckIfPrimaryUp", "backup", e)
             return None
         if resp.value == 1:
             # The backup acted as primary while we were down; its model is
@@ -1231,7 +1358,7 @@ class PrimaryServer:
 
                 call_with_retry(
                     self.retry_policy, "FetchModel", fetch,
-                    telemetry=self.telemetry,
+                    telemetry=self.telemetry, rand=self._retry_rand,
                 )
             except grpc.RpcError:
                 log.warning("backup demoted but FetchModel failed")
@@ -1241,6 +1368,96 @@ class PrimaryServer:
                     "after retries; keeping the local model"
                 )
         return resp.value
+
+    # --------------------------------------------------------------- fencing
+    def _handle_stale(self, rpc: str, peer: str, exc: grpc.RpcError) -> None:
+        """A receiver rejected us with STALE_COORDINATOR: another
+        coordinator minted a higher epoch while we were partitioned. Record
+        the winner's epoch (parsed from the rejection details, so the
+        re-base can mint past it even if the backup is unreachable) and
+        flip the fence flag — the round loop voids the in-flight round and
+        re-bases (:meth:`handle_fence`). Never marks ``peer`` failed: the
+        peer is healthy, WE are stale."""
+        try:
+            details = exc.details() or ""
+            self._epoch_seen = max(
+                self._epoch_seen, int(details.rsplit("<", 1)[1])
+            )
+        except Exception:
+            pass  # malformed details: re-base still mints past our own epoch
+        with self._fence_lock:
+            first = not self._fenced
+            self._fenced = True
+        if not first:
+            return
+        log.warning(
+            "FENCED by %s via %s: our epoch %d is stale (newest seen %d); "
+            "voiding the in-flight round and re-basing",
+            peer, rpc, self._coord_epoch, self._epoch_seen,
+        )
+        self.telemetry.counter(
+            "fedtpu_ft_fenced_total",
+            "times this coordinator was fenced by a STALE_COORDINATOR "
+            "rejection (superseded by a higher epoch)",
+        ).inc()
+        self.flight.record(
+            "fence", rpc=rpc, peer=peer, epoch=self._coord_epoch,
+            epoch_seen=self._epoch_seen,
+        )
+        self.flight.dump(reason="fence")
+
+    def handle_fence(self) -> None:
+        """Post-fence re-base (docs/FAULT_TOLERANCE.md §Fencing heal
+        timeline): demote the acting backup through the recovering
+        handshake (``CheckIfPrimaryUp(req=b"1")`` passes the backup's
+        stale check by design — the heal must work), adopt its state via
+        the existing FetchModel/_install path (install_state raises our
+        epoch to the winner's), then mint an epoch PAST everything seen
+        and re-broadcast on the next round's initial sync. Our forked
+        rounds are already voided — the fenced round never committed.
+
+        The fence only drops once the handshake is DELIVERED: minting past
+        the winner without adopting its state would re-fork the lineage —
+        the exact split-brain this protocol eliminates. While the winner
+        stays unreachable (an asymmetric partition healed client-side
+        first, or no backup channel exists at all) the coordinator holds
+        the fence — ``health()`` keeps reporting 503 — and retries every
+        ``_fence_retry_s``; an acting primary in that position simply
+        waits for the demotion the re-basing primary's handshake
+        delivers."""
+        if not self._fenced:
+            return
+        log.info("re-basing after fence (epoch %d, seen %d)",
+                 self._coord_epoch, self._epoch_seen)
+        if self.pinger is None:
+            # No channel to the winning lineage: state adoption is
+            # impossible from here, so resuming would fork. Hold the fence
+            # until demoted (acting primary) or restarted by the operator.
+            time.sleep(self._fence_retry_s)
+            return
+        self.pinger.recovering = True
+        if self.pinger.tick() is None:
+            # The heal is still partial (we are fenced via clients but the
+            # backup link is down). Stay fenced and retry.
+            time.sleep(self._fence_retry_s)
+            return
+        self._set_epoch(max(self._coord_epoch, self._epoch_seen) + 1)
+        self._did_initial_sync = False
+        with self._fence_lock:
+            self._fenced = False
+        self.flight.record("fence", event="rebased", epoch=self._coord_epoch)
+        log.info("re-based: continuing as epoch %d", self._coord_epoch)
+
+    def health(self) -> Tuple[bool, str]:
+        """Honest /healthz verdict: (ok, reason). 503-worthy while fenced
+        (stale coordinator pending re-base) or while the latest round
+        aborted under quorum — orchestrator probes can then act instead of
+        reading an unconditional 200."""
+        if self._fenced:
+            return False, "fenced: stale coordinator pending re-base"
+        if self.history and self.history[-1].get("aborted"):
+            return False, "quorum unmet: last round aborted"
+        return True, "ok"
 
     # ------------------------------------------------------------ membership
     def _make_stub(self, address: str) -> TrainerStub:
@@ -1453,6 +1670,14 @@ class PrimaryServer:
             rounds_aborted=sum(
                 1 for rec in self.history if rec.get("aborted")
             ),
+            # Fencing block (docs/FAULT_TOLERANCE.md §Fencing): which
+            # lineage this coordinator is, and whether it has been
+            # superseded and is pending re-base.
+            fencing={
+                "epoch": self._coord_epoch,
+                "role": "acting" if self._role == 2 else "primary",
+                "fenced": self._fenced,
+            },
         )
         tel = self.telemetry
         if tel.enabled:
@@ -1673,7 +1898,8 @@ class PrimaryServer:
                 # with the exception and the reply just vanished).
                 reply = stub.StartTrain(
                     proto.TrainRequest(
-                        rank=rank, world=world, round=lineage_round
+                        rank=rank, world=world, round=lineage_round,
+                        epoch=self._coord_epoch,
                     ),
                     timeout=self._deadlines["StartTrain"],
                 )
@@ -1756,6 +1982,7 @@ class PrimaryServer:
                     results[client] = call_with_retry(
                         self.retry_policy, "StartTrain", attempt,
                         peer=client, telemetry=tel,
+                        rand=self._retry_rand,
                     )
                 latencies[client] = time.monotonic() - t_rpc
                 tel.histogram(
@@ -1764,6 +1991,13 @@ class PrimaryServer:
                     "retries included; successful rounds only)",
                 ).observe(latencies[client])
             except (grpc.RpcError, wire.WireError) as e:
+                if is_stale_coordinator(e):
+                    # The client has seen a higher coordinator epoch: WE
+                    # are the stale side of a healed partition. The client
+                    # is healthy — never mark it failed; flip the fence
+                    # and let the round loop void this round and re-base.
+                    self._handle_stale("StartTrain", client, e)
+                    return
                 # Only a FATAL status or an exhausted retry budget lands
                 # here — the designed path to mark_failed.
                 if isinstance(e, grpc.RpcError):
@@ -1887,6 +2121,51 @@ class PrimaryServer:
             if c in results and c not in stragglers
         }
 
+        # Fenced mid-round (a collect worker hit STALE_COORDINATOR): VOID
+        # the round before anything commits — same clean-abort contract as
+        # the quorum path below (global model and optimizer state untouched,
+        # lineage counter frozen). Whatever replies arrived belong to a
+        # superseded lineage; run() re-bases via handle_fence before the
+        # next attempt.
+        if self._fenced:
+            with stream_lock:
+                dev_buf.clear()
+            self._did_initial_sync = False
+            log.warning(
+                "round %d voided: coordinator fenced mid-round (epoch %d "
+                "superseded); global model untouched",
+                self._round_counter, self._coord_epoch,
+            )
+            tel.counter(
+                "fedtpu_round_aborts_total",
+                "rounds aborted below quorum (global model untouched)",
+            ).inc()
+            self.flight.record(
+                "round_abort", round=self._round_counter,
+                participants=len(completed), fenced=True,
+            )
+            rec = {
+                "round": self._round_counter,
+                "epoch": self._coord_epoch,
+                "participants": len(completed),
+                "stragglers": len(stragglers),
+                "world": world,
+                "alive": [self.registry.is_alive(c) for c in roster_now],
+                "membership_version": membership_version,
+                "aborted": True,
+                "fenced": True,
+                "bytes_up": int(bytes_up.value),
+                "bytes_down": 0,
+                "pipeline": self.server_pipeline,
+                "t_collect_s": round(t_barrier - t_launch, 6),
+                "t_decode_s": round(decode_s.value, 6),
+                "t_h2d_s": round(h2d_s.value, 6),
+                "t_aggregate_s": 0.0,
+                "t_post_barrier_s": 0.0,
+            }
+            self.history.append(rec)
+            return rec
+
         # Round quorum (cfg.fed.round_quorum, fraction of this round's
         # SAMPLED clients): below it the round aborts CLEANLY — the global
         # model and server-optimizer state are left bit-identical to their
@@ -1928,6 +2207,7 @@ class PrimaryServer:
             )
             rec = {
                 "round": self._round_counter,
+                "epoch": self._coord_epoch,
                 "participants": len(completed),
                 "stragglers": len(stragglers),
                 "world": world,
@@ -2080,19 +2360,26 @@ class PrimaryServer:
                     call_with_retry(
                         self.retry_policy, "SendModel",
                         lambda: self.backup_stub.SendModel(
-                            proto.SendModelRequest(model=replica),
+                            proto.SendModelRequest(
+                                model=replica,
+                                epoch=self._coord_epoch, role=self._role,
+                            ),
                             timeout=self._deadlines["SendModel"],
                         ),
                         peer="backup", telemetry=tel,
+                        rand=self._retry_rand,
                     )
                 bytes_down.inc(len(replica))
-            except grpc.RpcError:
-                log.warning("backup unreachable during replication")
-                tel.counter(
-                    "fedtpu_rpc_failures_total",
-                    "RpcErrors by failing RPC",
-                    labels={"rpc": "Replicate"},
-                ).inc()
+            except grpc.RpcError as e:
+                if is_stale_coordinator(e):
+                    self._handle_stale("Replicate", "backup", e)
+                else:
+                    log.warning("backup unreachable during replication")
+                    tel.counter(
+                        "fedtpu_rpc_failures_total",
+                        "RpcErrors by failing RPC",
+                        labels={"rpc": "Replicate"},
+                    ).inc()
 
         def send_one(client: str) -> None:
             stub = self._stub(client)
@@ -2103,13 +2390,20 @@ class PrimaryServer:
                     call_with_retry(
                         self.retry_policy, "SendModel",
                         lambda: stub.SendModel(
-                            proto.SendModelRequest(model=payload),
+                            proto.SendModelRequest(
+                                model=payload,
+                                epoch=self._coord_epoch, role=self._role,
+                            ),
                             timeout=self._deadlines["SendModel"],
                         ),
                         peer=client, telemetry=tel,
+                        rand=self._retry_rand,
                     )
                 bytes_down.inc(len(payload))
             except grpc.RpcError as e:
+                if is_stale_coordinator(e):
+                    self._handle_stale("SendModel", client, e)
+                    return  # WE are stale; the client stays alive
                 log.warning(
                     "client %s failed during SendModel: %s %s",
                     client, e.code(), e.details(),
@@ -2164,6 +2458,10 @@ class PrimaryServer:
             # "step", each generation's local 0-based count. The churn
             # soak's monotone-counter gate reads this field.
             "round": self._round_counter - 1,
+            # The fencing epoch this round committed under: lineage
+            # accounting across a healed partition keys on it (a stale
+            # fork's records carry the superseded epoch).
+            "epoch": self._coord_epoch,
             "participants": len(completed),
             "stragglers": len(stragglers),
             "world": world,
@@ -2320,10 +2618,14 @@ class PrimaryServer:
                     call_with_retry(
                         self.retry_policy, "SendModel",
                         lambda: stub.SendModel(
-                            proto.SendModelRequest(model=payload),
+                            proto.SendModelRequest(
+                                model=payload,
+                                epoch=self._coord_epoch, role=self._role,
+                            ),
                             timeout=self._deadlines["SendModel"],
                         ),
                         peer=client, telemetry=tel,
+                        rand=self._retry_rand,
                     )
                     tel.counter(
                         "fedtpu_rpc_bytes_down_total",
@@ -2339,7 +2641,8 @@ class PrimaryServer:
                                 # Each client keeps its OWN seat's shard;
                                 # the synchronous path assigns the same
                                 # stable seat ranks (see round()'s rank_of).
-                                rank=rank, world=self.registry.capacity()
+                                rank=rank, world=self.registry.capacity(),
+                                epoch=self._coord_epoch,
                             ),
                             timeout=self._deadlines["StartTrain"],
                         )
@@ -2352,6 +2655,7 @@ class PrimaryServer:
                     reply, tree = call_with_retry(
                         self.retry_policy, "StartTrain", train_attempt,
                         peer=client, telemetry=tel,
+                        rand=self._retry_rand,
                     )
                     tel.counter(
                         "fedtpu_rpc_bytes_up_total",
@@ -2368,6 +2672,11 @@ class PrimaryServer:
                          base_version)
                     )
                 except (grpc.RpcError, wire.WireError) as e:
+                    if is_stale_coordinator(e):
+                        # We are superseded: the client stays alive; this
+                        # worker retires and the caller re-bases.
+                        self._handle_stale("AsyncWorker", client, e)
+                        return
                     if isinstance(e, grpc.RpcError):
                         log.warning(
                             "async client %s failed: %s %s",
@@ -2507,14 +2816,21 @@ class PrimaryServer:
                             self.retry_policy, "SendModel",
                             lambda: self.backup_stub.SendModel(
                                 proto.SendModelRequest(
-                                    model=self.replica_bytes()
+                                    model=self.replica_bytes(),
+                                    epoch=self._coord_epoch, role=self._role,
                                 ),
                                 timeout=self._deadlines["SendModel"],
                             ),
                             peer="backup", telemetry=tel,
+                            rand=self._retry_rand,
                         )
-                    except grpc.RpcError:
-                        log.warning("backup unreachable during replication")
+                    except grpc.RpcError as e:
+                        if is_stale_coordinator(e):
+                            self._handle_stale("Replicate", "backup", e)
+                        else:
+                            log.warning(
+                                "backup unreachable during replication"
+                            )
                 rec = {
                     "update": self._async_version,
                     "contributors": [c for c, _, _, _ in buf],
@@ -2589,6 +2905,11 @@ class PrimaryServer:
                 if stop is not None and stop():
                     log.info("round loop stopped (demotion) after %d rounds", r)
                     break
+                if self._fenced:
+                    # Superseded by a higher epoch (healed partition):
+                    # re-base on the winning lineage before training again.
+                    self.handle_fence()
+                    continue
                 rec = self.round()
                 if rec.get("aborted"):
                     # Sub-quorum round: the global is untouched; re-run it
@@ -2607,6 +2928,8 @@ class PrimaryServer:
                             "quorum; giving up", r, consecutive_aborts,
                         )
                         break
+                    if rec.get("fenced"):
+                        continue  # re-base immediately, no heartbeat wait
                     time.sleep(self.monitor.period)
                     continue
                 consecutive_aborts = 0
@@ -2716,14 +3039,55 @@ class BackupServer(TrainerServicer):
         # acting primary (each promotion gets a fresh event + thread).
         self._acting_stop: Optional[threading.Event] = None
         self._promote_thread: Optional[threading.Thread] = None
+        # Fencing (docs/FAULT_TOLERANCE.md §Fencing): the max coordinator
+        # epoch this backup has seen — on replication, on pings, and on its
+        # own promotions (each mint advances it). A lower-epoch replication
+        # or steady-state ping is a superseded primary and gets the typed
+        # STALE_COORDINATOR rejection.
+        self._epoch_seen = -1
 
     # ------------------------------------------------------------- servicer
+    def _fence_check(self, epoch: int, rpc: str, context) -> None:
+        """Track the max coordinator epoch; abort a stale sender (same
+        contract as ClientAgent._fence_check)."""
+        if epoch < 0:
+            return  # pre-fencing peer
+        if epoch >= self._epoch_seen:
+            self._epoch_seen = epoch
+            return
+        log.warning(
+            "%s from stale coordinator epoch %d rejected (newest seen %d)",
+            rpc, epoch, self._epoch_seen,
+        )
+        self.telemetry.counter(
+            "fedtpu_ft_stale_rejected_total",
+            "coordinator RPCs rejected for a stale fencing epoch, by rpc",
+            labels={"rpc": rpc},
+        ).inc()
+        context.abort(
+            grpc.StatusCode.FAILED_PRECONDITION,
+            f"STALE_COORDINATOR: epoch {epoch} < {self._epoch_seen}",
+        )
+
     def SendModel(self, request: proto.SendModelRequest, context) -> proto.SendModelReply:
+        # A stale primary's replica must never overwrite the replication
+        # slot: after we promoted past it, its lineage is void.
+        self._fence_check(request.epoch, "Replicate", context)
         self.latest_model = request.model
         return proto.SendModelReply(reply=b"replicated")
 
     def CheckIfPrimaryUp(self, request: proto.PingRequest, context) -> proto.PingResponse:
         recovering = request.req == b"1"
+        # Steady-state pings from a superseded primary are fenced — they
+        # must not keep resetting our watchdog (that would let a stale
+        # coordinator suppress re-promotion forever). The RECOVERING ping
+        # is the heal handshake (demote + FetchModel re-base) and must
+        # pass whatever its epoch, or a fenced ex-primary could never
+        # re-base through us.
+        if not recovering:
+            self._fence_check(request.epoch, "CheckIfPrimaryUp", context)
+        elif request.epoch > self._epoch_seen:
+            self._epoch_seen = request.epoch
         return proto.PingResponse(value=self.machine.on_ping(recovering))
 
     def HeartBeat(self, request: proto.Request, context) -> proto.HeartBeatResponse:
@@ -2776,11 +3140,23 @@ class BackupServer(TrainerServicer):
                 None if since == float("inf") else round(since, 3)
             ),
             "has_replica": self.latest_model is not None,
+            "epoch_seen": self._epoch_seen,
         }
         acting = self.acting
         if acting is not None and machine.role.value == "acting_primary":
             snap["acting"] = acting.status_snapshot()
         return snap
+
+    def health(self) -> Tuple[bool, str]:
+        """Honest /healthz for the backup role: while acting primary,
+        delegate to the acting coordinator's verdict (fenced / quorum);
+        in the backup role the process is healthy by construction."""
+        from fedtpu.ft import Role
+
+        acting = self.acting
+        if self.machine.role is Role.ACTING_PRIMARY and acting is not None:
+            return acting.health()
+        return True, "ok"
 
     # -------------------------------------------------------------- failover
     def _promote(self) -> None:
@@ -2815,6 +3191,15 @@ class BackupServer(TrainerServicer):
                 flight=self.flight,
                 chaos=self.chaos,
             )
+        # Mint the promotion epoch: strictly past both the replicated
+        # lineage's epoch (installed above from the replica payload) and
+        # anything this backup has ever seen on the wire. From now on the
+        # old primary's epoch is stale everywhere this coordinator speaks.
+        acting._set_epoch(max(acting._coord_epoch, self._epoch_seen) + 1)
+        acting._role = 2
+        self._epoch_seen = acting._coord_epoch
+        log.warning("promotion minted coordinator epoch %d",
+                    acting._coord_epoch)
         self.acting = acting
 
         def run_acting():

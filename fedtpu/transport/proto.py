@@ -137,12 +137,20 @@ class TrainRequest:
     # never as round -1 colliding with a real round 0; stock
     # ``federated_pb2`` peers skip the unknown field.
     round: int = -1
+    # Additive field 4: the sender's coordinator EPOCH, or -1 when absent
+    # (pre-fencing peers). Minted on every promotion; receivers track the
+    # max epoch seen and reject lower-epoch senders with STALE_COORDINATOR
+    # so a healed partition cannot fork the lineage
+    # (docs/FAULT_TOLERANCE.md §Fencing). Same +1 omit-zero trick as
+    # ``round``: epoch 0 stays distinguishable from "absent".
+    epoch: int = -1
 
     def encode(self) -> bytes:
         return _encode_fields([
             (1, _VARINT, self.rank),
             (2, _VARINT, self.world),
             (3, _VARINT, self.round + 1),
+            (4, _VARINT, self.epoch + 1),
         ])
 
     @classmethod
@@ -152,6 +160,7 @@ class TrainRequest:
             rank=_int32(f.get(1, 0)),
             world=_int32(f.get(2, 0)),
             round=_int32(f.get(3, 0)) - 1,
+            epoch=_int32(f.get(4, 0)) - 1,
         )
 
 
@@ -170,13 +179,29 @@ class TrainReply:
 @dataclasses.dataclass
 class SendModelRequest:
     model: bytes = b""
+    # Additive fields 2/3: coordinator epoch (+1 encoded, -1 = absent, see
+    # TrainRequest.epoch) and the sender's ROLE (0 = unset/legacy,
+    # 1 = configured primary, 2 = acting primary). Role rides along so the
+    # backup and flight recorder can attribute a replica stream without
+    # decoding the payload; proto3 omit-zero keeps legacy bytes identical.
+    epoch: int = -1
+    role: int = 0
 
     def encode(self) -> bytes:
-        return _encode_fields([(1, _LEN, self.model)])
+        return _encode_fields([
+            (1, _LEN, self.model),
+            (2, _VARINT, self.epoch + 1),
+            (3, _VARINT, self.role),
+        ])
 
     @classmethod
     def decode(cls, data: bytes) -> "SendModelRequest":
-        return cls(model=_decode_fields(data).get(1, b""))
+        f = _decode_fields(data)
+        return cls(
+            model=f.get(1, b""),
+            epoch=_int32(f.get(2, 0)) - 1,
+            role=_int32(f.get(3, 0)),
+        )
 
 
 @dataclasses.dataclass
@@ -217,13 +242,22 @@ class HeartBeatResponse:
 @dataclasses.dataclass
 class PingRequest:
     req: bytes = b""
+    # Additive field 2: coordinator epoch (+1 encoded, -1 = absent). Lets
+    # the backup fence a stale primary's liveness probes — a partitioned
+    # ex-primary must not keep resetting the watchdog of a backup that has
+    # already promoted past it.
+    epoch: int = -1
 
     def encode(self) -> bytes:
-        return _encode_fields([(1, _LEN, self.req)])
+        return _encode_fields([
+            (1, _LEN, self.req),
+            (2, _VARINT, self.epoch + 1),
+        ])
 
     @classmethod
     def decode(cls, data: bytes) -> "PingRequest":
-        return cls(req=_decode_fields(data).get(1, b""))
+        f = _decode_fields(data)
+        return cls(req=f.get(1, b""), epoch=_int32(f.get(2, 0)) - 1)
 
 
 @dataclasses.dataclass

@@ -43,6 +43,24 @@ def status_name(exc: grpc.RpcError) -> str:
     return code.name if code is not None else "UNKNOWN"
 
 
+def is_stale_coordinator(exc: BaseException) -> bool:
+    """Is this a typed STALE_COORDINATOR fence rejection? Receivers abort
+    with FAILED_PRECONDITION and a details string starting with the token,
+    so a fenced sender can distinguish "I have been superseded" (self-demote
+    and re-base) from an ordinary fatal RPC error (mark the peer failed).
+    FAILED_PRECONDITION is deliberately NOT in ``transient_codes`` — a
+    fence rejection must never be retried."""
+    if not isinstance(exc, grpc.RpcError):
+        return False
+    try:
+        code = exc.code()
+        details = exc.details() or ""
+    except Exception:
+        return False
+    return (code == grpc.StatusCode.FAILED_PRECONDITION
+            and "STALE_COORDINATOR" in details)
+
+
 def is_transient(exc: BaseException, policy: RetryPolicy) -> bool:
     """Retryable under ``policy``? Wire corruption is always transient
     (reject-and-retry: the bytes were damaged in flight, the peer is
@@ -74,13 +92,17 @@ def call_with_retry(
     peer: str = "",
     telemetry: Optional[object] = None,
     sleep: Callable[[float], None] = time.sleep,
+    rand: Optional[Callable[[], float]] = None,
 ) -> T:
     """Run ``attempt_fn`` (one full RPC attempt, including reply decode) up
     to ``policy.max_attempts`` times. Transient failures back off and
     retry, incrementing ``fedtpu_rpc_retries_total{rpc}`` on ``telemetry``
     (a :class:`fedtpu.obs.Telemetry`, or None); the final (or first fatal)
     exception propagates unchanged so callers keep their existing
-    ``except grpc.RpcError`` / ``except WireError`` handling."""
+    ``except grpc.RpcError`` / ``except WireError`` handling. ``rand``
+    (a 0..1 draw, e.g. a seeded ``random.Random(...).random``) replaces
+    the global jitter source so chaos-soak timing replays
+    deterministically; None keeps the module default."""
     attempts = max(1, policy.max_attempts)
     for attempt in range(1, attempts + 1):
         try:
@@ -94,7 +116,7 @@ def call_with_retry(
                     "transient RPC failures retried, by rpc",
                     labels={"rpc": rpc},
                 ).inc()
-            delay = backoff_s(policy, attempt)
+            delay = backoff_s(policy, attempt, rand or random.random)
             why = (
                 status_name(exc)
                 if isinstance(exc, grpc.RpcError)

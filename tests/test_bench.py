@@ -331,6 +331,48 @@ def test_screening_overhead_microbench_contract(bench, monkeypatch, tmp_path):
         assert json_mod.load(f) == result
 
 
+def test_fencing_overhead_microbench_contract(bench, monkeypatch, tmp_path):
+    """--fencing-overhead-microbench at a seconds-scale config: schema +
+    artifact emission (the <=1%-on-densenet acceptance gate itself is
+    pinned by the committed artifacts/FENCING_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_FE_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_FE_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_FE_REPS", "2")
+    result = bench._fencing_overhead_microbench()
+    assert result["metric"] == "fencing_overhead"
+    assert result["value"] > 0
+    assert result["per_rpc_us"]["inject_validate"] > 0
+    # The attributable arithmetic is auditable from its own parts:
+    # StartTrain + SendModel per client, plus ping + replica push.
+    assert result["rpcs_per_round"] == result["num_clients"] * 2 + 2
+    per_round = result["rpcs_per_round"] * result["per_rpc_us"]["inject_validate"]
+    assert result["per_round_fencing_us"] == pytest.approx(per_round, rel=1e-3)
+    assert result["gate_pct"] == 1.0
+    assert isinstance(result["passes_gate"], bool)
+    assert result["noise_floor_pct"] >= 0
+    assert set(result["round_ms"]) == {"bare", "fenced"}
+    assert all(v > 0 for v in result["round_ms"].values())
+    path = os.path.join(str(art), "FENCING_MICROBENCH.json")
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
+def test_fencing_microbench_committed_gate():
+    """The committed densenet-scale artifact must actually pass the <=1%
+    gate: per-RPC epoch inject + fence validation across every fenced RPC
+    a synchronous round issues."""
+    result = _committed_artifact("FENCING_MICROBENCH.json")
+    assert result["metric"] == "fencing_overhead"
+    assert result["model"] == "densenet_cifar"
+    assert result["passes_gate"] is True
+    assert result["value"] <= 1.0
+
+
 def test_checkpoint_overhead_microbench_contract(bench, monkeypatch, tmp_path):
     """--checkpoint-overhead-microbench at a seconds-scale config: schema
     + artifact emission (the <=1%-on-densenet acceptance gate itself is
@@ -414,6 +456,37 @@ def test_disaster_soak_artifact_contract():
     assert result["final_round"]["disaster"] == cfg["rounds"] - 1
     for e in result["final_evals"]:
         assert e["loss"] == e["loss"]
+
+
+def test_partition_soak_artifact_contract():
+    """Schema + gate contract of the committed three-leg partition-heal
+    soak (tools/chaos_soak.py --partition): the split-brain-elimination
+    PR's acceptance evidence. The soak re-runs as `slow`
+    (tests/test_fencing.py); this pins what it must have proven."""
+    result = _committed_artifact("PARTITION_SOAK.json")
+    assert result["ok"] is True and result["soak"] == "partition"
+    legs = result["legs"]
+    assert set(legs) == {"symmetric", "asymmetric", "gray"}
+    for leg in legs.values():
+        assert leg["ok"] is True
+        # Zero transient client deaths; a real fence + live rejection.
+        assert leg["client_deaths"] == 0
+        assert leg["fences"] >= 1
+        assert leg["stale_rejections"] >= 1
+        assert leg["acting_rounds"] >= 1
+        # Bounded failover churn, every promotion eventually demoted.
+        assert 1 <= leg["promotions"] <= 8
+        assert leg["demotions"] == leg["promotions"]
+        # The fenced side re-based PAST the winner (1 -> 2 -> >= 3).
+        assert leg["final_epoch"] >= 3
+    # Symmetric: cut side never forked, and the heal was
+    # trajectory-neutral (bit-identical to the no-partition control).
+    sym = legs["symmetric"]
+    assert sym["bit_identical_vs_control"] is True
+    assert sym["stale_fork_rounds"] == 0 and sym["promotions"] == 1
+    # Asymmetric: a REAL split-brain — the stale primary committed >= 1
+    # forked round that the epoch-supersession fold voided.
+    assert legs["asymmetric"]["stale_fork_rounds"] >= 1
 
 
 def test_byzantine_soak_artifact_contract():

@@ -133,6 +133,63 @@ def test_disk_kinds_parse_and_stay_in_their_class():
     assert "ckpt_rot@Disk" in sched.describe()
 
 
+def test_net_kinds_parse_groups_windows_and_stay_in_their_class():
+    """partition/flaky (the link-fault class): ride the wire interceptors
+    but model the LINK — group-keyed peers (peer=a|b) cut a whole side
+    with one rule, wall-clock windows (window=lo-hi seconds since arm)
+    bound the outage on paths that never learn a round number, and the
+    class never crosses into the pseudo-RPCs."""
+    grpc = pytest.importorskip("grpc")
+    sched = parse_spec(
+        "partition@StartTrain:peer=a|b,window=0-30;"
+        "flaky@CheckIfPrimaryUp:p=0.5,delay=0.05,code=UNAVAILABLE,seed=3"
+    )
+    part, flaky = sched.rules
+    assert part.is_net and flaky.is_net
+    assert part.peer == "a|b" and part.window == (0.0, 30.0)
+    assert "window=0-30" in sched.describe()
+    # Group-keyed match: both sides of the group are cut, others pass.
+    assert sched.decide("StartTrain", "a").kind == "partition"
+    assert sched.decide("StartTrain", "b").kind == "partition"
+    assert sched.decide("StartTrain", "c") is None
+    # partition severs FAST: immediate UNAVAILABLE, no blackhole sleep.
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError) as exc:
+        sched.apply_precall(part, "StartTrain")
+    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert "partitioned" in exc.value.details()
+    assert time.monotonic() - t0 < 0.2
+    # flaky is the gray link: stalls delay_s, then fails with `code`.
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError) as exc:
+        sched.apply_precall(flaky, "CheckIfPrimaryUp")
+    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert "flaky" in exc.value.details()
+    assert time.monotonic() - t0 >= 0.05
+    # Class discipline + window sanity are parse errors, not silent no-ops.
+    for bad in ("partition@Round:p=1", "partition@Attack:p=1",
+                "partition@Disk:p=1", "flaky@Round:p=1",
+                "partition@StartTrain:window=5-2",
+                "partition@StartTrain:window=30"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+
+def test_net_window_heals_on_wall_clock():
+    """A window=lo-hi rule matches only while the schedule's wall clock is
+    inside [lo, hi) — 'the partition healed' is simply the window closing.
+    Pinned by rebasing the schedule's arm time, not by sleeping."""
+    sched = parse_spec("partition@StartTrain:peer=a,window=5-10")
+    # t=0: before the cut opens.
+    assert sched.decide("StartTrain", "a") is None
+    # t~7: inside the cut.
+    sched._t0 = time.monotonic() - 7.0
+    assert sched.decide("StartTrain", "a").kind == "partition"
+    # t~12: healed; the same rule goes silent.
+    sched._t0 = time.monotonic() - 12.0
+    assert sched.decide("StartTrain", "a") is None
+
+
 # ----------------------------------------------------- schedule semantics
 def test_schedule_is_deterministic_and_seed_sensitive():
     def draws(seed):

@@ -1,0 +1,332 @@
+"""Partition-tolerant coordination: epoch fencing + split-brain elimination.
+
+A network partition (unlike a crash) leaves TWO live coordinators: the
+watchdog promotes the backup while the old primary keeps serving its
+side. Coordinator epochs fence the stale side when the partition heals
+(docs/FAULT_TOLERANCE.md §Coordinator fencing): every promotion mints a
+higher epoch, receivers reject lower-epoch senders with the typed
+``STALE_COORDINATOR`` status, and the fenced ex-primary voids its forked
+round and re-bases through the recovering handshake.
+
+Tier-1 here: the stale-epoch rejection contract against a LIVE client
+agent, the stay-fenced-while-winner-unreachable rule, and the in-process
+symmetric partition-heal drill (promote -> heal -> fence -> re-base ->
+single exact-cover lineage, bit-identical to a no-partition control).
+The three-leg soak (``tools/chaos_soak.py --partition``) re-runs as
+``slow``.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import chaos_soak  # noqa: E402
+import rolling_upgrade as ru  # noqa: E402
+
+from fedtpu.config import RetryPolicy  # noqa: E402
+from fedtpu.transport import proto  # noqa: E402
+from fedtpu.transport.retry import is_stale_coordinator  # noqa: E402
+
+
+def _csum(regs, name) -> float:
+    """Sum a counter (all label sets) across metrics registries."""
+    from fedtpu.obs import parse_prometheus_text, prometheus_text
+
+    total = 0.0
+    for reg in regs:
+        if reg is None:
+            continue
+        total += sum(parse_prometheus_text(prometheus_text(reg)).get(
+            name, {}).values())
+    return total
+
+
+def _registry(coord):
+    tel = coord.telemetry
+    return tel.registry if tel.enabled else None
+
+
+# ------------------------------------------------- stale-epoch unit pins
+def test_stale_epoch_rpcs_rejected_by_live_client():
+    """The receiver-side fencing contract, pinned over real gRPC: a live
+    ClientAgent tracks the max coordinator epoch and rejects lower-epoch
+    StartTrain/SendModel with FAILED_PRECONDITION + STALE_COORDINATOR —
+    without touching trainer state — while legacy (epoch-less) traffic
+    keeps working."""
+    from fedtpu.transport.federation import serve_client
+    from fedtpu.transport.service import TrainerStub, create_channel
+
+    cfg = chaos_soak._tiny_cfg(1, 4)
+    addr = f"localhost:{chaos_soak.free_port()}"
+    server, agent = serve_client(addr, cfg, seed=0)
+    try:
+        stub = TrainerStub(create_channel(addr))
+        # Epoch 5 is the newest seen -> accepted, trains a round.
+        reply = stub.StartTrain(
+            proto.TrainRequest(rank=0, world=1, round=0, epoch=5),
+            timeout=180,
+        )
+        assert reply.message
+        assert agent._max_epoch == 5
+        before = agent.trainer.round_idx
+
+        # A stale coordinator (epoch 3 < 5) is rejected with the TYPED
+        # status, and the rejection names the newest epoch so the fenced
+        # sender can mint past it.
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.StartTrain(
+                proto.TrainRequest(rank=0, world=1, round=1, epoch=3),
+                timeout=30,
+            )
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "STALE_COORDINATOR" in (ei.value.details() or "")
+        assert (ei.value.details() or "").rstrip().endswith("5")
+        assert is_stale_coordinator(ei.value)
+        assert agent.trainer.round_idx == before  # no training happened
+
+        # SendModel is fenced BEFORE the payload decode: garbage bytes
+        # from a stale sender never reach the installer.
+        with pytest.raises(grpc.RpcError) as ei2:
+            stub.SendModel(
+                proto.SendModelRequest(model=b"junk", epoch=4, role=1),
+                timeout=30,
+            )
+        assert ei2.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "STALE_COORDINATOR" in (ei2.value.details() or "")
+
+        # Pre-fencing peers advertise no epoch (-1) and are never fenced.
+        reply = stub.StartTrain(
+            proto.TrainRequest(rank=0, world=1, round=1), timeout=180,
+        )
+        assert reply.message
+        assert agent.trainer.round_idx == before + 1
+
+        reg = agent.trainer.telemetry.registry
+        assert reg.counter(
+            "fedtpu_ft_stale_rejected_total", labels={"rpc": "StartTrain"},
+        ).value == 1
+        assert reg.counter(
+            "fedtpu_ft_stale_rejected_total", labels={"rpc": "SendModel"},
+        ).value == 1
+    finally:
+        server.stop(0)
+
+
+def test_fenced_coordinator_stays_fenced_until_winner_reachable():
+    """A fenced coordinator must NOT resume by minting past the winner
+    while the winner is unreachable — adopting the winning state first is
+    what eliminates the split-brain. With the backup link down (or no
+    backup at all) handle_fence holds the fence and /healthz stays 503."""
+    from fedtpu.transport.federation import PrimaryServer
+
+    cfg = chaos_soak._tiny_cfg(1, 2)
+    # Backup address bound to nothing: the recovering handshake cannot land.
+    dead = f"localhost:{chaos_soak.free_port()}"
+    primary = PrimaryServer(cfg, ["localhost:1"], backup_address=dead)
+    primary._fence_retry_s = 0.01
+    primary._fenced = True
+    primary._epoch_seen = 5
+    primary.handle_fence()
+    assert primary._fenced, "re-based without reaching the winner"
+    assert primary._coord_epoch == 1, "minted past an unadopted lineage"
+    ok, reason = primary.health()
+    assert not ok and "fenced" in reason
+
+    # No backup channel at all (an acting primary awaiting demotion, or a
+    # standalone primary): same rule — hold the fence.
+    lone = PrimaryServer(cfg, ["localhost:1"])
+    assert lone.pinger is None
+    lone._fence_retry_s = 0.01
+    lone._fenced = True
+    lone._epoch_seen = 7
+    lone.handle_fence()
+    assert lone._fenced and lone._coord_epoch == 1
+
+
+# ------------------------------------------------ partition-heal drill
+def test_symmetric_partition_heal_single_lineage_bit_identical():
+    """The tier-1 acceptance drill: a symmetric partition (primary cut
+    from backup AND clients) promotes the backup, which mints epoch 2 and
+    commits rounds; on heal the old primary is fenced by live
+    STALE_COORDINATOR rejections, voids its in-flight round, re-bases
+    through the recovering handshake (demote + FetchModel), mints epoch 3
+    and finishes the run. Exactly one lineage exact-covers 0..N-1, no
+    client ever dies, and the final model is BIT-IDENTICAL to a run that
+    never partitioned."""
+    from fedtpu.ft import Role
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.transport.federation import BackupServer, PrimaryServer
+
+    rounds, pre, clients = 8, 3, 2
+    # The retry budget must outlast the partition window: a partitioned
+    # link fails FAST (no sleep), so capped backoff keeps the StartTrain
+    # collect workers retrying (~0.25 s apart, ~150 s of coverage) until
+    # the heal — transient faults never kill clients.
+    cfg = chaos_soak._tiny_cfg(
+        clients, rounds,
+        round_quorum=1.0,
+        server_optimizer="momentum",
+        ft_heartbeat_period_s=0.5,
+        retry=RetryPolicy(max_attempts=600, backoff_s=0.05,
+                          backoff_multiplier=1.5, backoff_max_s=0.25),
+    )
+
+    addrs, servers, agents = ru.build_fleet(cfg, clients, seed0=0)
+    backup_addr = f"localhost:{chaos_soak.free_port()}"
+    group = "|".join([backup_addr] + addrs)
+    # Wall-clock window, manually steered via the schedule's epoch base:
+    # closed at start, opened at the exact committed-round boundary (the
+    # on_round callback below), healed once the acting primary has
+    # committed rounds.
+    sched = parse_spec(f"partition@*:peer={group},p=1,window=3600-1000000")
+
+    lock = threading.Lock()
+    timeline = []  # (source, record) in arrival order
+
+    def collect(src):
+        def cb(r, rec):
+            with lock:
+                timeline.append((src, dict(rec)))
+            if (src == "primary" and not rec.get("aborted")
+                    and rec["round"] == pre - 1):
+                # Open the partition at this exact lineage boundary.
+                sched._t0 = time.monotonic() - 3601.0
+        return cb
+
+    def committed(src=None):
+        with lock:
+            return [
+                rec for s, rec in timeline
+                if not rec.get("aborted") and (src is None or s == src)
+            ]
+
+    backup = backup_srv = primary = None
+    bail = threading.Event()
+    try:
+        backup = BackupServer(
+            cfg, addrs, watchdog_timeout=2.0,
+            on_acting_round=collect("acting"),
+        )
+        backup_srv = backup.start(backup_addr)
+        primary = PrimaryServer(
+            cfg, addrs, backup_address=backup_addr, chaos=sched,
+        )
+        errs = []
+
+        def drive():
+            try:
+                primary.run(
+                    num_rounds=10**9,
+                    stop=lambda: bail.is_set()
+                    or (primary._coord_epoch > 1
+                        and not primary._fenced
+                        and primary._round_counter >= rounds),
+                    on_round=collect("primary"),
+                )
+            except BaseException as exc:  # surfaced by the main thread
+                errs.append(exc)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+
+        deadline = time.monotonic() + 240
+        while backup.acting is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert backup.acting is not None, "backup never promoted"
+        acting = backup.acting
+        while len(committed("acting")) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(committed("acting")) >= 2, "acting committed no rounds"
+
+        # Heal: the window closes; the primary's in-flight retries now
+        # reach peers that saw epoch 2 and fence it.
+        sched._t0 = time.monotonic() - 10_000_000.0
+        t.join(timeout=240)
+        assert not t.is_alive(), "primary round loop never finished"
+        assert not errs, errs
+
+        # ---- exactly one lineage, exact cover, correct epoch chain ----
+        recs = committed()
+        lineage = [r["round"] for r in recs]
+        assert lineage == list(range(rounds)), lineage
+        srcs = [s for s, rec in timeline if not rec.get("aborted")]
+        k = len(committed("acting"))
+        assert srcs == ["primary"] * pre + ["acting"] * k + \
+            ["primary"] * (rounds - pre - k), srcs
+        epochs = [r["epoch"] for r in recs]
+        assert epochs == [1] * pre + [2] * k + [3] * (rounds - pre - k), \
+            epochs
+
+        # The fenced void: the stale primary's in-flight round aborted
+        # with the fence marker on its superseded epoch, and the global
+        # model was untouched (the bit-identity gate below proves it).
+        voided = [
+            rec for s, rec in timeline
+            if s == "primary" and rec.get("fenced")
+        ]
+        assert voided and voided[0]["epoch"] == 1, timeline
+
+        # ---- protocol state after the heal ----
+        assert primary._coord_epoch == 3 and not primary._fenced
+        assert acting._coord_epoch == 2 and acting._role == 2
+        assert backup.machine.role is Role.BACKUP
+        assert backup._epoch_seen >= 3  # post-heal pings/replication
+        assert primary.health() == (True, "ok")
+
+        # ---- zero deaths, one fence, live stale rejections ----
+        coords = [_registry(primary), _registry(acting)]
+        assert _csum(coords, "fedtpu_ft_client_deaths_total") == 0
+        assert _csum([_registry(primary)], "fedtpu_ft_fenced_total") == 1
+        client_regs = [a.trainer.telemetry.registry for a in agents]
+        assert _csum(client_regs, "fedtpu_ft_stale_rejected_total") >= 1
+        transitions = _csum([_registry(backup)],
+                            "fedtpu_ft_failover_transitions_total")
+        assert transitions == 2  # one promote + one demote, no storm
+
+        # Every committed round trained every client exactly once (the
+        # stale lineage never reached them).
+        assert [a.trainer.round_idx for a in agents] == [rounds] * clients
+        u_model = ru.model_fingerprint(primary)
+    finally:
+        sched._t0 = time.monotonic() - 10_000_000.0  # heal for teardown
+        bail.set()
+        if backup is not None:
+            backup.watchdog.stop()
+            backup._stop_acting(wait=30.0)
+        if backup_srv is not None:
+            backup_srv.stop(0)
+        ru.stop_fleet(servers)
+
+    # ------------------------- control: same run, no partition, no backup
+    addrs2, servers2, agents2 = ru.build_fleet(cfg, clients, seed0=0)
+    try:
+        control = PrimaryServer(cfg, addrs2)
+        control.run(num_rounds=rounds)
+        assert [a.trainer.round_idx for a in agents2] == [rounds] * clients
+        c_model = ru.model_fingerprint(control)
+    finally:
+        ru.stop_fleet(servers2)
+
+    assert ru.bit_identical(c_model, u_model), (
+        "post-heal global model differs from the no-partition control — "
+        "the forked lineage leaked into the surviving trajectory"
+    )
+
+
+# ------------------------------------------------------------- slow soak
+@pytest.mark.slow
+def test_partition_soak_three_legs():
+    """The full acceptance soak: symmetric, asymmetric and gray-flap legs
+    (see tools/chaos_soak.py --partition)."""
+    result = chaos_soak.run_partition_soak(verbose=True)
+    assert result["ok"]
+    assert result["legs"]["symmetric"]["bit_identical_vs_control"]
+    assert result["legs"]["asymmetric"]["stale_fork_rounds"] >= 1
+    assert result["legs"]["gray"]["promotions"] >= 1

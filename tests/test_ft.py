@@ -161,6 +161,135 @@ def test_full_failover_cycle():
     assert m.check_watchdog()
     assert m.role is Role.ACTING_PRIMARY
 
+class LockedClock(FakeClock):
+    """FakeClock safe to read/advance from racing threads."""
+
+    def __init__(self):
+        super().__init__()
+        import threading
+
+        self._lk = threading.Lock()
+
+    def __call__(self):
+        with self._lk:
+            return self.t
+
+    def advance(self, dt):
+        with self._lk:
+            self.t += dt
+
+
+def test_failover_threaded_watchdog_promotes_exactly_once():
+    """Race: N watchdog threads all observe an expired window and call
+    check_watchdog simultaneously. The machine's lock must collapse them
+    into EXACTLY one promotion — one True return, one callback, one
+    transition metric — never a double-promote (each would spin up its own
+    acting-primary round loop)."""
+    import threading
+
+    from fedtpu.obs import MetricsRegistry
+
+    for _trial in range(20):
+        clock = LockedClock()
+        events = []
+        reg = MetricsRegistry()
+        m = FailoverStateMachine(
+            timeout=10.0, clock=clock, metrics=reg,
+            on_promote=lambda: events.append("promote"),
+        )
+        m.on_ping(recovering=False)      # arm
+        clock.advance(11.0)
+        barrier = threading.Barrier(8)
+        results, res_lock = [], threading.Lock()
+
+        def worker():
+            barrier.wait()
+            fired = m.check_watchdog()
+            with res_lock:
+                results.append(fired)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 1, "watchdog race double-promoted"
+        assert events == ["promote"]
+        assert m.role is Role.ACTING_PRIMARY
+        assert reg.counter(
+            "fedtpu_ft_failover_transitions_total",
+            labels={"to": "acting_primary"},
+        ).value == 1
+
+
+def test_failover_threaded_ping_vs_watchdog_keeps_invariants():
+    """Race: a recovering primary's on_ping lands WHILE the watchdog
+    thread keeps firing on expired windows. Both transitions run under the
+    machine's lock, so whatever the interleaving: promotes and demotes
+    strictly alternate (counts never diverge by more than one), the final
+    role is exactly the transition parity, and every transition fired its
+    metric exactly once — none doubled, none skipped."""
+    import threading
+
+    from fedtpu.obs import MetricsRegistry
+
+    clock = LockedClock()
+    reg = MetricsRegistry()
+    counts = {"promote": 0, "demote": 0}
+    cnt_lock = threading.Lock()
+
+    def bump(key):
+        with cnt_lock:
+            counts[key] += 1
+
+    m = FailoverStateMachine(
+        timeout=10.0, clock=clock, metrics=reg,
+        on_promote=lambda: bump("promote"),
+        on_demote=lambda: bump("demote"),
+    )
+    m.on_ping(recovering=False)          # arm
+    iters = 200
+    start = threading.Barrier(2)
+
+    def watchdog_side():
+        start.wait()
+        for _ in range(iters):
+            clock.advance(11.0)          # every check sees an expired window
+            m.check_watchdog()
+
+    def ping_side():
+        start.wait()
+        for _ in range(iters):
+            m.on_ping(recovering=True)   # demotes whenever acting
+
+    threads = [threading.Thread(target=watchdog_side),
+               threading.Thread(target=ping_side)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # Strict alternation promote/demote from BACKUP: the counts can never
+    # diverge by more than one, and the residue must match the role.
+    assert counts["demote"] <= counts["promote"] <= counts["demote"] + 1
+    assert (m.role is Role.ACTING_PRIMARY) == (
+        counts["promote"] == counts["demote"] + 1
+    )
+    assert counts["promote"] >= 1, "the race never promoted at all"
+    # Every transition produced exactly one metric increment.
+    assert reg.counter(
+        "fedtpu_ft_failover_transitions_total",
+        labels={"to": "acting_primary"},
+    ).value == counts["promote"]
+    assert reg.counter(
+        "fedtpu_ft_failover_transitions_total",
+        labels={"to": "backup"},
+    ).value == counts["demote"]
+    # Settle: one more recovering ping must leave it cleanly in BACKUP.
+    m.on_ping(recovering=True)
+    assert m.role is Role.BACKUP
+
+
 def test_chaos_kill_revive_schedule_still_converges():
     """Randomized fault schedule over 20 rounds: every round each client
     flips dead/alive with some probability (at least one always lives).

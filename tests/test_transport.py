@@ -41,6 +41,39 @@ def test_train_request_round_field():
     ).round == 0
 
 
+def test_epoch_fields_roundtrip_and_stay_wire_compatible():
+    """The additive fencing-epoch fields (split-brain elimination): same
+    round+1 omit-zero pattern as TrainRequest.round — epoch unset (-1)
+    adds ZERO bytes, so a fencing-aware peer's legacy traffic is
+    byte-identical to a pre-fencing encoder's, and old bytes decode as
+    epoch=-1 ("absent"), never colliding with a real epoch 0."""
+    # TrainRequest.epoch (field 4).
+    for ep in (-1, 0, 1, 42, 2**20):
+        msg = proto.TrainRequest(rank=1, world=4, round=2, epoch=ep)
+        assert proto.TrainRequest.decode(msg.encode()) == msg
+    legacy = proto.TrainRequest(rank=3, world=8)
+    assert legacy.encode() == b"\x08\x03\x10\x08"  # no field-3/4 tags at all
+    assert proto.TrainRequest.decode(legacy.encode()).epoch == -1
+    assert proto.TrainRequest.decode(
+        proto.TrainRequest(epoch=0).encode()
+    ).epoch == 0
+    # SendModelRequest.epoch (field 2, +1) and .role (field 3, plain: 0 is
+    # the legacy/unset default and stays off the wire).
+    for ep, role in [(-1, 0), (0, 1), (7, 2)]:
+        msg = proto.SendModelRequest(model=b"m", epoch=ep, role=role)
+        assert proto.SendModelRequest.decode(msg.encode()) == msg
+    legacy_sm = proto.SendModelRequest(model=b"payload")
+    assert legacy_sm.encode() == b"\x0a\x07payload"  # field 1 only
+    got = proto.SendModelRequest.decode(legacy_sm.encode())
+    assert (got.epoch, got.role) == (-1, 0)
+    # PingRequest.epoch (field 2, +1).
+    for ep in (-1, 0, 9):
+        msg = proto.PingRequest(req=b"r", epoch=ep)
+        assert proto.PingRequest.decode(msg.encode()) == msg
+    assert proto.PingRequest(req=b"x").encode() == b"\x0a\x01x"
+    assert proto.PingRequest.decode(b"\x0a\x01x").epoch == -1
+
+
 def test_bytes_messages_roundtrip():
     payload = bytes(range(256)) * 100  # non-UTF8 on purpose
     for cls, field in [

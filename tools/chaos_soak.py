@@ -12,7 +12,12 @@ RSS gauge. Writes ``artifacts/CHURN_SOAK.json``. ``--disaster`` runs the
 TOTAL-PROCESS-LOSS drill (:func:`run_disaster_soak`): primary and backup
 SIGKILLed mid-round under seeded disk faults, cold restart from the
 hardened checkpoint store with generation fallback, bit-identical to a
-no-crash control — ``artifacts/DISASTER_SOAK.json``.
+no-crash control — ``artifacts/DISASTER_SOAK.json``. ``--partition`` runs
+the PARTITION-HEAL soak (:func:`run_partition_soak`): symmetric,
+asymmetric (split-brain fork) and gray-flap legs driven by ``partition``/
+``flaky`` chaos rules, gated on epoch fencing leaving exactly one
+surviving exact-cover lineage with zero transient client deaths and
+bounded failover churn — ``artifacts/PARTITION_SOAK.json``.
 
 What it proves (the acceptance spine of the chaos/resilience PR;
 docs/FAULT_TOLERANCE.md):
@@ -1468,6 +1473,356 @@ def run_churn_soak(
     return result
 
 
+# ------------------------------------------------- partition-heal soak
+def _supersession_lineage(recs):
+    """Fold arrival-ordered committed round records (from EVERY
+    coordinator that ever ran) into the SURVIVING lineage under epoch
+    supersession (docs/FAULT_TOLERANCE.md §Coordinator fencing): a
+    higher-epoch commit at round ``r`` supersedes every previously-kept
+    round ``>= r`` (the winner re-based past the fork), and a lower-epoch
+    commit arriving after the winner's is a stale fork's and void.
+    Returns ``(survivors, voided)``."""
+    kept, voided, cur = [], [], -1
+    for rec in recs:
+        e, r = rec["epoch"], rec["round"]
+        if e > cur:
+            voided.extend(k for k in kept if k["round"] >= r)
+            kept = [k for k in kept if k["round"] < r]
+            kept.append(rec)
+            cur = e
+        elif e == cur:
+            kept.append(rec)
+        else:
+            voided.append(rec)
+    return kept, voided
+
+
+def _partition_leg(mode: str, rounds: int, partition_round: int,
+                   clients: int, seed: int, verbose: bool) -> dict:
+    """One leg of the partition-heal soak, over the live gRPC transport:
+
+    - ``symmetric``  — a ``partition`` group rule cuts the primary from
+      backup AND clients; the watchdog promotes, the acting primary
+      (epoch 2) commits rounds; on heal the stale primary is fenced via
+      live STALE_COORDINATOR rejections, voids its in-flight round,
+      re-bases (demote + FetchModel, epoch 3) and finishes. Gated
+      bit-identical to a no-partition control.
+    - ``asymmetric`` — only the primary->backup direction is cut: the
+      backup hears silence and promotes while clients still obey the old
+      primary, which keeps committing a STALE FORK. The acting primary's
+      sync fences it mid-fork; it stays fenced (the backup link is still
+      down, so the recovering handshake cannot land) until the heal.
+      Gated on the supersession fold voiding >= 1 forked round while the
+      survivors exact-cover the lineage.
+    - ``gray``       — a ``flaky`` rule flaps ONLY the watchdog ping
+      path for a bounded window (delays past the watchdog timeout, then
+      fails): promote/fence/re-base cycles churn, but stay BOUNDED and
+      the lineage converges once the window closes.
+
+    Every leg gates zero transient client deaths and a final demoted
+    backup + healthy (200) primary. Returns the leg's evidence dict;
+    raises AssertionError on any gate."""
+    import threading
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import rolling_upgrade as ru
+
+    from fedtpu.config import RetryPolicy
+    from fedtpu.ft import Role
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import parse_prometheus_text, prometheus_text
+    from fedtpu.transport.federation import BackupServer, PrimaryServer
+
+    def vlog(msg):
+        if verbose:
+            print(f"[partition:{mode}] {msg}", flush=True)
+
+    def registry(coord):
+        tel = coord.telemetry
+        return tel.registry if tel.enabled else None
+
+    def csum(regs, name):
+        total = 0.0
+        for reg in regs:
+            if reg is None:
+                continue
+            total += sum(parse_prometheus_text(
+                prometheus_text(reg)).get(name, {}).values())
+        return total
+
+    gray_window_s = 8.0
+    if mode == "symmetric":
+        # The cut includes the client links: only a LONG capped-backoff
+        # retry budget keeps the collect workers retrying (partitioned
+        # links fail instantly, so attempts are cheap) until the heal.
+        retry = RetryPolicy(max_attempts=600, backoff_s=0.05,
+                            backoff_multiplier=1.5, backoff_max_s=0.25)
+        watchdog = 2.0
+    else:
+        # Client links stay clean; backup-link failures should resolve
+        # FAST so the stale fork keeps committing (asymmetric) and flap
+        # cycles stay short (gray).
+        retry = RetryPolicy(max_attempts=4, backoff_s=0.05,
+                            backoff_multiplier=1.5, backoff_max_s=0.1)
+        watchdog = 2.5 if mode == "asymmetric" else 1.5
+    cfg = _tiny_cfg(
+        clients, rounds,
+        round_quorum=1.0,
+        server_optimizer="momentum",
+        ft_heartbeat_period_s=0.5,
+        retry=retry,
+    )
+
+    addrs, servers, agents = ru.build_fleet(cfg, clients, seed0=seed)
+    backup_addr = f"localhost:{free_port()}"
+    if mode == "symmetric":
+        group = "|".join([backup_addr] + addrs)
+        spec = f"partition@*:peer={group},p=1,window=3600-1000000"
+    elif mode == "asymmetric":
+        spec = f"partition@*:peer={backup_addr},p=1,window=3600-1000000"
+    else:
+        spec = (f"flaky@CheckIfPrimaryUp:p=0.8,delay=2.5,code=UNAVAILABLE,"
+                f"seed={seed},window=3600-{3600 + gray_window_s:.0f}")
+    sched = parse_spec(spec)
+
+    lock = threading.Lock()
+    timeline = []   # (source, round record) in arrival order
+    actings = []    # every acting PrimaryServer ever observed
+
+    def on_rec(src):
+        def cb(r, rec):
+            with lock:
+                timeline.append((src, dict(rec)))
+            if (src == "primary" and not rec.get("aborted")
+                    and rec.get("epoch") == 1
+                    and rec["round"] == partition_round - 1):
+                # Open the fault window at this exact lineage boundary
+                # (the callback runs synchronously inside the round loop).
+                sched._t0 = time.monotonic() - 3601.0
+                vlog(f"window OPEN after round {rec['round']}")
+        return cb
+
+    def committed(src=None):
+        with lock:
+            return [rec for s, rec in timeline
+                    if not rec.get("aborted") and src in (None, s)]
+
+    healed = threading.Event()
+    bail = threading.Event()
+    result = {"mode": mode, "rounds": rounds, "clients": clients,
+              "partition_round": partition_round, "watchdog_s": watchdog,
+              "spec": spec}
+    backup = BackupServer(cfg, addrs, watchdog_timeout=watchdog,
+                          on_acting_round=on_rec("acting"))
+    backup_srv = backup.start(backup_addr)
+    primary = PrimaryServer(cfg, addrs, backup_address=backup_addr,
+                            chaos=sched)
+    errs = []
+
+    def drive():
+        try:
+            # healed gates the exit so a flap can never strand a live
+            # acting primary after the stale side already finished.
+            primary.run(
+                num_rounds=10**9,
+                stop=lambda: bail.is_set() or (
+                    healed.is_set()
+                    and primary._coord_epoch > 1
+                    and not primary._fenced
+                    and primary._round_counter >= rounds),
+                on_round=on_rec("primary"),
+            )
+        except BaseException as exc:  # surfaced by the soak thread
+            errs.append(exc)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    try:
+        def harvest():
+            a = backup.acting
+            if a is not None and all(a is not x for x in actings):
+                actings.append(a)
+                vlog(f"acting primary #{len(actings)} "
+                     f"(epoch {a._coord_epoch})")
+
+        def wait_for(cond, what, timeout=420.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                harvest()
+                if errs:
+                    raise AssertionError(
+                        f"{mode}: primary loop died: {errs[0]!r}")
+                if cond():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"{mode}: timed out waiting for {what}")
+
+        wait_for(lambda: actings, "watchdog promotion")
+        if mode == "symmetric":
+            wait_for(lambda: len(committed("acting")) >= 2,
+                     "acting-primary commits")
+            sched._t0 = time.monotonic() - 10_000_000.0
+            healed.set()
+            vlog("window HEALED")
+        elif mode == "asymmetric":
+            # The fence arrives over the CLIENT links (the acting sync's
+            # higher epoch) while the backup link is still down — the
+            # primary must hold the fence rather than mint past a winner
+            # it cannot reach.
+            wait_for(lambda: primary._fenced,
+                     "fence via client-side rejections")
+            vlog("stale primary fenced mid-fork")
+            wait_for(lambda: len(committed("acting")) >= 2,
+                     "acting-primary commits")
+            sched._t0 = time.monotonic() - 10_000_000.0
+            healed.set()
+            vlog("window HEALED")
+        else:  # gray: the window expires on its own
+            wait_for(
+                lambda: time.monotonic() - sched._t0
+                > 3600 + gray_window_s + 0.5,
+                "flap-window expiry",
+            )
+            healed.set()
+            vlog("window EXPIRED")
+        t.join(timeout=420.0)
+        assert not t.is_alive(), f"{mode}: round loop never finished"
+        assert not errs, errs
+        wait_for(lambda: backup.machine.role is Role.BACKUP,
+                 "final demotion", timeout=60.0)
+        harvest()
+
+        # ---- exactly ONE surviving lineage, exact cover ----
+        survivors, voided = _supersession_lineage(committed())
+        lineage = [r["round"] for r in survivors]
+        if mode == "gray":
+            # The exit is gated on window expiry (so a flap can never
+            # strand a live acting primary), and the lineage keeps
+            # committing while the link flaps: gate a CONTIGUOUS exact
+            # cover 0..K-1 of at least the configured length.
+            assert (lineage == list(range(len(lineage)))
+                    and len(lineage) >= rounds), (
+                f"gray: surviving lineage is not a contiguous cover: "
+                f"{lineage}")
+        else:
+            assert lineage == list(range(rounds)), (
+                f"{mode}: surviving lineage is not an exact cover: "
+                f"{lineage}")
+        result["lineage_rounds"] = len(lineage)
+        result["stale_fork_rounds"] = len(voided)
+        result["epoch_chain"] = sorted({r["epoch"] for r in survivors})
+        if mode == "symmetric":
+            # The cut primary could never commit forked rounds: its
+            # in-flight round died on unreachable clients and was voided.
+            assert not voided, f"symmetric: unexpected fork: {voided}"
+        if mode == "asymmetric":
+            assert len(voided) >= 1, (
+                "asymmetric: the stale primary committed no forked "
+                "rounds before the fence — the leg proved nothing")
+        result["acting_rounds"] = len(committed("acting"))
+        assert result["acting_rounds"] >= 1
+
+        # ---- post-heal protocol state ----
+        assert primary._coord_epoch >= 3 and not primary._fenced, (
+            mode, primary._coord_epoch, primary._fenced)
+        assert primary.health() == (True, "ok")
+        result["final_epoch"] = primary._coord_epoch
+
+        # ---- bounded failover churn ----
+        breg = backup.telemetry.registry
+        promotions = int(breg.counter(
+            "fedtpu_ft_failover_transitions_total",
+            labels={"to": "acting_primary"}).value)
+        demotions = int(breg.counter(
+            "fedtpu_ft_failover_transitions_total",
+            labels={"to": "backup"}).value)
+        result["promotions"], result["demotions"] = promotions, demotions
+        assert promotions >= 1
+        if mode == "gray":
+            # window / watchdog + slack: flapping must stay BOUNDED — a
+            # promotion storm would mean fencing amplifies the gray link.
+            assert promotions <= 8, f"promotion storm: {promotions}"
+        else:
+            assert promotions == 1, (mode, promotions)
+        assert demotions == promotions, (promotions, demotions)
+
+        # ---- zero transient deaths; the fence actually fired ----
+        coord_regs = [registry(primary)] + [registry(a) for a in actings]
+        deaths = csum(coord_regs, "fedtpu_ft_client_deaths_total")
+        assert deaths == 0, f"{mode}: {deaths} transient client deaths"
+        result["client_deaths"] = int(deaths)
+        fences = csum(coord_regs, "fedtpu_ft_fenced_total")
+        assert fences >= 1
+        if mode != "gray":
+            assert fences == 1, (mode, fences)
+        result["fences"] = int(fences)
+        stale = csum(
+            [a_.trainer.telemetry.registry for a_ in agents]
+            + [backup.telemetry.registry],
+            "fedtpu_ft_stale_rejected_total")
+        assert stale >= 1, f"{mode}: no live STALE_COORDINATOR rejection"
+        result["stale_rejections"] = int(stale)
+
+        if mode == "symmetric":
+            # The stale lineage never reached a client: every committed
+            # round trained every client exactly once.
+            counts = [a_.trainer.round_idx for a_ in agents]
+            assert counts == [rounds] * clients, counts
+            u_model = ru.model_fingerprint(primary)
+    finally:
+        sched._t0 = time.monotonic() - 10_000_000.0  # heal for teardown
+        bail.set()
+        backup.watchdog.stop()
+        backup._stop_acting(wait=30.0)
+        backup_srv.stop(0)
+        ru.stop_fleet(servers)
+
+    if mode == "symmetric":
+        addrs2, servers2, agents2 = ru.build_fleet(cfg, clients,
+                                                   seed0=seed)
+        try:
+            control = PrimaryServer(cfg, addrs2)
+            control.run(num_rounds=rounds)
+            c_model = ru.model_fingerprint(control)
+        finally:
+            ru.stop_fleet(servers2)
+        result["bit_identical_vs_control"] = ru.bit_identical(
+            c_model, u_model)
+        assert result["bit_identical_vs_control"], (
+            "symmetric: post-heal global model differs from the "
+            "no-partition control — the fork leaked into the surviving "
+            "trajectory")
+    vlog("leg complete: " + json.dumps(
+        {k: v for k, v in result.items() if k != "spec"}))
+    result["ok"] = True
+    return result
+
+
+def run_partition_soak(rounds: int = 20, clients: int = 3,
+                       partition_round: int = 6, seed: int = 7,
+                       verbose: bool = False) -> dict:
+    """The partition-tolerance acceptance soak: three legs (symmetric
+    cut, asymmetric cut, gray flap — see :func:`_partition_leg`) over the
+    live gRPC transport. Writes ``artifacts/PARTITION_SOAK.json`` via
+    ``main``; the fast in-process drill is tier-1 in
+    ``tests/test_fencing.py``."""
+    legs = {}
+    for mode in ("symmetric", "asymmetric", "gray"):
+        t0 = time.monotonic()
+        legs[mode] = _partition_leg(
+            mode, rounds, partition_round, clients, seed, verbose)
+        legs[mode]["wall_s"] = round(time.monotonic() - t0, 2)
+    return {
+        "ok": all(leg["ok"] for leg in legs.values()),
+        "soak": "partition",
+        "rounds_per_leg": rounds,
+        "clients": clients,
+        "partition_round": partition_round,
+        "seed": seed,
+        "legs": legs,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", default=20, type=int)
@@ -1517,9 +1872,43 @@ def main(argv=None) -> int:
     ap.add_argument("--upgrade-round", default=None, type=int,
                     help="lineage round of the mid-soak rolling upgrade "
                     "(default: --churn-rounds / 2)")
+    ap.add_argument(
+        "--partition", action="store_true",
+        help="run the partition-heal soak instead: three legs over live "
+        "gRPC — symmetric cut (backup promotes; on heal the stale "
+        "primary is fenced, voids its round, re-bases; bit-identical to "
+        "a no-partition control), asymmetric cut (split-brain: the stale "
+        "side commits a FORK that the epoch fold voids), gray flap "
+        "(flaky watchdog pings; promote/demote churn stays bounded). "
+        "Gates zero transient deaths + one surviving exact-cover "
+        "lineage; writes artifacts/PARTITION_SOAK.json",
+    )
+    ap.add_argument("--partition-rounds", default=20, type=int)
+    ap.add_argument("--partition-round", default=6, type=int,
+                    help="lineage round after which each leg's fault "
+                    "window opens")
+    ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.partition:
+        try:
+            result = run_partition_soak(
+                rounds=args.partition_rounds,
+                clients=args.clients,
+                partition_round=args.partition_round,
+                seed=args.seed,
+                verbose=args.verbose,
+            )
+        except AssertionError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}))
+            return 1
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "PARTITION_SOAK.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        print(json.dumps(result))
+        return 0
     if args.disaster:
         try:
             result = run_disaster_soak(

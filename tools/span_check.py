@@ -107,6 +107,8 @@ def check(package_dir: str = None, doc_path: str = None) -> List[str]:
                 "no entry in docs/OBSERVABILITY.md"
             )
     problems.extend(check_metrics(package_dir, doc_path))
+    if package_dir is None:
+        problems.extend(check_chaos_kinds())
     return problems
 
 
@@ -130,6 +132,42 @@ def check_metrics(package_dir: str = None, doc_path: str = None) -> List[str]:
     return problems
 
 
+def check_chaos_kinds(doc_path: str = None) -> List[str]:
+    """Chaos fault-kind drift problems (empty = pass): every kind name in
+    ``fedtpu.ft.chaos.KINDS`` must appear as inline code in
+    docs/FAULT_TOLERANCE.md's DSL grammar — a new fault class
+    (``NET_KINDS`` and whatever follows) cannot ship undocumented.
+    chaos.py is loaded standalone (importlib, stdlib-only module) so this
+    check never drags jax into a docs-lint environment."""
+    import importlib.util
+
+    doc_path = doc_path or os.path.join(REPO, "docs", "FAULT_TOLERANCE.md")
+    chaos_path = os.path.join(REPO, "fedtpu", "ft", "chaos.py")
+    spec = importlib.util.spec_from_file_location("_span_check_chaos",
+                                                  chaos_path)
+    chaos = importlib.util.module_from_spec(spec)
+    # Registered for the exec: dataclass processing resolves the module's
+    # (string) annotations through sys.modules.
+    sys.modules[spec.name] = chaos
+    try:
+        spec.loader.exec_module(chaos)
+        kinds = tuple(chaos.KINDS)
+    finally:
+        sys.modules.pop(spec.name, None)
+    documented = documented_names(doc_path)
+    problems = []
+    if not kinds:
+        problems.append("fedtpu.ft.chaos.KINDS is empty — the kind registry "
+                        "or loader drifted; fix tools/span_check.py")
+    for kind in sorted(kinds):
+        if kind not in documented:
+            problems.append(
+                f"chaos fault kind {kind!r} (fedtpu/ft/chaos.py KINDS) has "
+                "no entry in docs/FAULT_TOLERANCE.md"
+            )
+    return problems
+
+
 def main(argv=None) -> int:
     problems = check()
     if problems:
@@ -138,7 +176,8 @@ def main(argv=None) -> int:
         return 1
     n = len(emitted_span_names())
     m = len(emitted_metric_names())
-    print(f"ok: {n} span names + {m} metric names emitted, all documented")
+    print(f"ok: {n} span names + {m} metric names emitted + chaos kinds, "
+          "all documented")
     return 0
 
 

@@ -221,6 +221,14 @@ class DataConfig:
     partition: str = "round_robin"  # round_robin | iid | dirichlet
     dirichlet_alpha: float = 0.5
     augment: bool = True  # random crop + flip (reference: src/main.py:37-42)
+    # The random-crop half of the augmentation (the horizontal flip always
+    # applies while ``augment`` is on). The crop is the shift-accumulate
+    # "fastcrop" formulation (fedtpu.data.augment, default-on; measured 2.0x
+    # on-chip vs the dynamic-slice crop, artifacts/BENCH_LIVE_r04_fastcrop).
+    # ``augment_crop=False`` skips the crop entirely — flip-only, with a
+    # bit-parity pin in tests (the rng split structure is shared, so the
+    # flip draw is identical either way).
+    augment_crop: bool = True
     seed: int = 0
     # Truncate the loaded dataset (None = full). Mainly for tests and quick
     # runs; the reference always trains on the full set.
@@ -454,6 +462,69 @@ class FedConfig:
     # escalation. Unlike the robust aggregators this composes with
     # server_pipeline='stream' and with aggregator='mean'.
     screen: ScreenConfig = dataclasses.field(default_factory=ScreenConfig)
+    # Device compute dtype for the local-training fast path
+    # (docs/PERF_ANALYSIS.md §Roofline).
+    #   "float32": full-precision parity (the seed default). The legacy
+    #     RoundConfig.dtype knob keeps selecting the activation dtype for
+    #     callers that set it directly (the bench has always run bf16
+    #     activations through it).
+    #   "bfloat16_mixed": params, activations and the device-resident
+    #     dataset live in bf16 through the (fused) local step — master-copy
+    #     mixed precision: FederatedState.params stays f32 and the bf16
+    #     cast happens at use inside the jitted step, so gradients, the
+    #     [clients, P] flat aggregation surface, FedOpt moments, screening
+    #     statistics and checkpoints all keep f32 semantics (test-pinned).
+    #     Measured lever: bf16 residency alone was worth 2.4x on-chip
+    #     (artifacts/BENCH_LIVE_r04_bf16.json).
+    compute_dtype: str = "float32"  # float32 | bfloat16_mixed
+    # Fold k simulated clients into ONE [k*batch, features] MXU pass inside
+    # the vmapped round body (fedtpu.core.round): a group of k clients
+    # shares one parameter trajectory per round (sound because every client
+    # starts each round at the same global params), per-example weights
+    # keep masked/dead members exact, and per-member metrics + deltas are
+    # broadcast back onto the [clients] axis so screening, compression and
+    # aggregation are untouched. Raises arithmetic intensity for the
+    # small-model zoo: k skinny matmuls become one wide one (the
+    # bandwidth-bound diagnosis in artifacts/MFU_PROFILE_r04*.json).
+    # 0 = off (the per-client path, the parity default); k >= 1 engages the
+    # megabatched body (k=1 is the debug setting, test-pinned bit-identical
+    # to the per-client path); k must divide num_clients. k > 1 is a
+    # documented approximation: members share BN batch statistics over the
+    # k*batch examples, one augment/dropout rng stream and one optimizer
+    # trajectory per group.
+    megabatch_clients: int = 0
+
+
+def resolve_compute_dtype(cfg: "RoundConfig") -> str:
+    """Resolve the effective activation/param compute dtype for the local
+    step, as a dtype name ("float32" | "bfloat16").
+
+    ``FedConfig.compute_dtype`` is the user-facing switch:
+    ``"bfloat16_mixed"`` resolves to bf16 compute over the f32 master
+    state; ``"float32"`` defers to the legacy ``RoundConfig.dtype`` knob so
+    callers that set it directly (bench variants) keep working unchanged.
+    """
+    if cfg.fed.compute_dtype not in ("float32", "bfloat16_mixed"):
+        raise ValueError(
+            f"unknown compute_dtype {cfg.fed.compute_dtype!r}; "
+            "have float32 | bfloat16_mixed"
+        )
+    if cfg.fed.compute_dtype == "bfloat16_mixed":
+        return "bfloat16"
+    return cfg.dtype
+
+
+def validate_megabatch(fed: FedConfig) -> None:
+    """Raise on inconsistent megabatch settings (cheap, before build work)."""
+    k = fed.megabatch_clients
+    if k < 0:
+        raise ValueError(f"megabatch_clients must be >= 0, got {k}")
+    if k and fed.num_clients % k:
+        raise ValueError(
+            f"megabatch_clients={k} must divide num_clients="
+            f"{fed.num_clients}: the group regrouping is a static reshape "
+            "of the [clients] axis"
+        )
 
 
 def resolve_server_pipeline(fed: FedConfig) -> str:

@@ -12,8 +12,15 @@ import jax
 import jax.numpy as jnp
 
 
-def augment_batch(rng: jax.Array, x: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
+def augment_batch(
+    rng: jax.Array, x: jnp.ndarray, pad: int = 4, crop: bool = True
+) -> jnp.ndarray:
     """Random crop (zero-pad) + horizontal flip for an NHWC batch.
+
+    ``crop=False`` (``DataConfig.augment_crop``) skips the crop entirely and
+    applies the flip alone. The rng split structure is shared between both
+    modes, so the flip decisions are bit-identical whether the crop is on or
+    off — the two modes differ ONLY by the crop (test-pinned).
 
     Divergence note: torchvision pads raw pixel 0 *before* normalisation
     (reference transform order, ``src/main.py:37-42``); here the pad is 0 in
@@ -45,20 +52,25 @@ def augment_batch(rng: jax.Array, x: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
     n, h, w, c = x.shape
     nshift = 2 * pad + 1
     crop_rng, flip_rng = jax.random.split(rng)
-    padded = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
+    if crop:
+        padded = jnp.pad(
+            x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+        )
 
-    offs = jax.random.randint(crop_rng, (n, 2), 0, nshift)
-    w_h = jax.nn.one_hot(offs[:, 0], nshift, dtype=x.dtype)  # [n, nshift]
-    w_w = jax.nn.one_hot(offs[:, 1], nshift, dtype=x.dtype)
+        offs = jax.random.randint(crop_rng, (n, 2), 0, nshift)
+        w_h = jax.nn.one_hot(offs[:, 0], nshift, dtype=x.dtype)  # [n, nshift]
+        w_w = jax.nn.one_hot(offs[:, 1], nshift, dtype=x.dtype)
 
-    rows = sum(
-        w_h[:, s, None, None, None] * padded[:, s:s + h, :, :]
-        for s in range(nshift)
-    )
-    cropped = sum(
-        w_w[:, s, None, None, None] * rows[:, :, s:s + w, :]
-        for s in range(nshift)
-    )
+        rows = sum(
+            w_h[:, s, None, None, None] * padded[:, s:s + h, :, :]
+            for s in range(nshift)
+        )
+        cropped = sum(
+            w_w[:, s, None, None, None] * rows[:, :, s:s + w, :]
+            for s in range(nshift)
+        )
+    else:
+        cropped = x
 
     flip = jax.random.bernoulli(flip_rng, 0.5, (n,))
     return jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)

@@ -166,6 +166,203 @@ def analytic_flops(fn: Callable, *args, **kwargs) -> float:
     return _count_jaxpr(closed.jaxpr)
 
 
+# Pure shape/metadata primitives: XLA lowers these to layout bookkeeping or
+# folds them into neighbouring fusions — they move no HBM bytes of their own
+# (counting a scalar broadcast to [clients, ...] as traffic would swamp the
+# model with phantom bytes).
+_LAYOUT_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose",
+    "copy", "stop_gradient",
+})
+
+# Elementwise primitives XLA reliably folds into loop fusions: a chain of
+# these runs as ONE pass over the data, so intermediates between them never
+# touch HBM. The byte model groups maximal connected runs (see
+# :func:`_bytes_jaxpr`) and charges only tensors crossing group boundaries.
+_ELEMENTWISE_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "neg", "abs", "max", "min", "pow",
+    "integer_pow", "square", "sqrt", "rsqrt", "exp", "exp2", "log", "log1p",
+    "expm1", "tanh", "sin", "cos", "logistic", "erf", "erf_inv", "erfc",
+    "sign", "floor", "ceil", "round", "clamp", "rem", "nextafter",
+    "select_n", "convert_element_type", "reduce_precision", "eq", "ne",
+    "lt", "le", "gt", "ge", "and", "or", "not", "xor", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "is_finite", "add_any",
+    "atan2",
+})
+
+# Reductions fuse with their PRODUCERS (XLA input fusion: the reduce is the
+# fusion root, reading its operand from registers), but their outputs are
+# materialization points — consumers start a fresh pass over the data.
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "reduce_prod", "argmax", "argmin",
+})
+
+_FUSIBLE_PRIMS = _LAYOUT_PRIMS | _ELEMENTWISE_PRIMS | _REDUCE_PRIMS
+
+
+def _aval_bytes(var) -> float:
+    if hasattr(var, "val"):  # Literal: a compile-time constant, not traffic
+        return 0.0
+    aval = getattr(var, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0.0
+    return float(math.prod(shape)) * dtype.itemsize
+
+
+def _bytes_jaxpr(jaxpr) -> float:
+    """Fusion-aware byte walk of one jaxpr level.
+
+    Greedy producer->consumer fusion grouping over
+    :data:`_FUSIBLE_PRIMS`: a maximal connected run of elementwise /
+    layout / reduction eqns is ONE pass over the data, charging only the
+    tensors that cross its boundary (read once by each consuming group,
+    written once by the producer) — intermediates inside a group are
+    register traffic, not HBM. Reduction outputs always materialize
+    (consumers re-read). Non-fusible ops (conv, dot, gather, rng, ...)
+    are singleton groups, i.e. charged per-eqn input+output exactly as
+    before. Layout eqns alias their output to their operand, so a
+    pure-layout group charges nothing and a broadcast feeding another
+    group charges its (small) operand, not the phantom broadcast bytes.
+    scan/while bodies counted ONCE (the module's convention —
+    comparable with XLA ``cost_analysis``); cond takes the worst branch.
+    """
+    eqns = jaxpr.eqns
+    total = 0.0
+    opaque = set()
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name == "cond":
+            branches = eqn.params.get("branches", ())
+            total += max(
+                (_bytes_jaxpr(b.jaxpr) for b in branches), default=0.0
+            )
+            opaque.add(i)
+            continue
+        subs = list(_subjaxprs(eqn.params))
+        if subs:
+            # The container eqn's own full-array operands are NOT added on
+            # top: the body's boundary tensors carry the traffic.
+            for sub in subs:
+                total += _bytes_jaxpr(sub)
+            opaque.add(i)
+
+    producer: Dict[Any, int] = {}
+    alias: Dict[Any, Any] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+        if (
+            i not in opaque
+            and eqn.primitive.name in _LAYOUT_PRIMS
+            and eqn.invars
+        ):
+            alias[eqn.outvars[0]] = eqn.invars[0]
+
+    def resolve(v):
+        while not hasattr(v, "val") and v in alias:
+            v = alias[v]
+        return v  # a Literal endpoint charges 0 via _aval_bytes
+
+    def fusible(i: int) -> bool:
+        return i not in opaque and eqns[i].primitive.name in _FUSIBLE_PRIMS
+
+    parent = list(range(len(eqns)))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i, eqn in enumerate(eqns):
+        if not fusible(i):
+            continue
+        for v in eqn.invars:
+            if hasattr(v, "val"):  # Literal
+                continue
+            p = producer.get(v)
+            if (
+                p is not None
+                and fusible(p)
+                and eqns[p].primitive.name not in _REDUCE_PRIMS
+            ):
+                parent[find(i)] = find(p)
+
+    def gid(i: int):
+        return ("f", find(i)) if fusible(i) else ("op", i)
+
+    consumers: Dict[Any, list] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):
+                consumers.setdefault(v, []).append(i)
+
+    out_set = set(v for v in jaxpr.outvars if not hasattr(v, "val"))
+    reads: Dict[Any, set] = {}
+    writes: Dict[Any, set] = {}
+    has_real: Dict[Any, bool] = {}
+    for i, eqn in enumerate(eqns):
+        if i in opaque:
+            continue
+        g = gid(i)
+        if eqn.primitive.name not in _LAYOUT_PRIMS:
+            has_real[g] = True
+        for v in eqn.invars:
+            if hasattr(v, "val"):
+                continue
+            p = producer.get(v)
+            if p is None or gid(p) != g:
+                r = resolve(v)
+                if not hasattr(r, "val"):
+                    reads.setdefault(g, set()).add(r)
+        for v in eqn.outvars:
+            cons = consumers.get(v, [])
+            ext = (
+                v in out_set
+                or not cons
+                or eqn.primitive.name in _REDUCE_PRIMS
+                or any(gid(c) != g for c in cons)
+            )
+            if ext:
+                w = resolve(v)
+                if not hasattr(w, "val"):
+                    writes.setdefault(g, set()).add(w)
+    for g in set(reads) | set(writes):
+        if not has_real.get(g):
+            continue  # pure-layout group: bookkeeping, no traffic
+        total += sum(_aval_bytes(v) for v in reads.get(g, ()))
+        total += sum(_aval_bytes(v) for v in writes.get(g, ()))
+    return total
+
+
+def analytic_bytes(fn: Callable, *args, **kwargs) -> float:
+    """Analytic HBM-traffic model of ``fn(*args)``: fusion-group boundary
+    bytes at the JAXPR avals' stated dtypes, scan/while bodies counted
+    once, shape/layout primitives free (see :func:`_bytes_jaxpr`).
+
+    This is deliberately BACKEND-INDEPENDENT — read off the traced jaxpr,
+    never the lowered HLO — because it exists to predict the TPU HBM
+    effect of dtype/layout levers from a host without the chip: a CPU
+    backend's ``cost_analysis`` bytes describe bf16 *emulation* (f32
+    upconverts inserted by the CPU lowering), which inverts the very
+    signal being measured. Fusion-awareness matters for the same reason:
+    an unfused per-eqn count charges the f32 intermediates of e.g. a
+    BatchNorm statistics chain at 5x activation size, even though XLA
+    folds the whole chain into one pass over the (compute-dtype) input —
+    biasing the count AGAINST exactly the dtype lever being measured.
+    Greedy elementwise grouping is still a model, not a compiler:
+    absolute numbers are approximate; mode-over-mode RATIOS (f32 vs
+    bf16_mixed, per-client vs megabatched) are the supported use.
+    On-chip, prefer the XLA figure (:func:`xla_cost`), which is measured
+    from the optimised HLO."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _bytes_jaxpr(closed.jaxpr)
+
+
 def xla_cost(compiled) -> Dict[str, float]:
     """``{"flops": ..., "bytes": ...}`` from a compiled executable's
     ``cost_analysis()`` (normalising the list-wrapped form some PJRT
@@ -235,10 +432,12 @@ class CostModel:
         xla_flops: Optional[float] = None,
         xla_bytes: Optional[float] = None,
         analytic: Optional[float] = None,
+        analytic_bytes: Optional[float] = None,
     ):
         self.xla_flops = xla_flops or None
         self.xla_bytes = xla_bytes or None
         self.analytic = analytic or None
+        self.analytic_bytes = analytic_bytes or None
         self.flops = self.xla_flops or self.analytic
         self.source = (
             "xla" if self.xla_flops else
@@ -254,6 +453,7 @@ class CostModel:
             "flops_per_round": self.flops,
             "bytes_per_round": self.xla_bytes,
             "analytic_flops_per_round": self.analytic,
+            "analytic_bytes_per_round": self.analytic_bytes,
             "flops_source": self.source,
             "analytic_vs_xla": self.agreement,
         }
@@ -279,11 +479,15 @@ def engine_cost_model(fed, xla_check: bool = True) -> CostModel:
         fed.state, d_images, d_labels, d_idx, d_mask, fed.weights, alive,
         fed._data_key, *extra,
     )
-    analytic = None
+    analytic = ab = None
     try:
-        analytic = analytic_flops(fed._data_step, *args)
+        import jax
+
+        closed = jax.make_jaxpr(fed._data_step)(*args)
+        analytic = _count_jaxpr(closed.jaxpr)
+        ab = _bytes_jaxpr(closed.jaxpr)
     except Exception as e:  # pragma: no cover - backend quirks
-        log.debug("analytic FLOP model failed: %s", e)
+        log.debug("analytic FLOP/byte model failed: %s", e)
     xf = xb = None
     if xla_check:
         try:
@@ -292,7 +496,9 @@ def engine_cost_model(fed, xla_check: bool = True) -> CostModel:
             xf, xb = cost["flops"], cost["bytes"]
         except Exception as e:  # pragma: no cover - backend quirks
             log.debug("XLA cost analysis unavailable: %s", e)
-    return CostModel(xla_flops=xf, xla_bytes=xb, analytic=analytic)
+    return CostModel(
+        xla_flops=xf, xla_bytes=xb, analytic=analytic, analytic_bytes=ab
+    )
 
 
 # ---------------------------------------------------------- round profiler

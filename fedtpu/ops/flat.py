@@ -26,6 +26,15 @@ Padding rule: the pad region is ALWAYS zero on entry to every op here, and
 every op here preserves that (thresholding keeps zeros at zero, quantization
 maps 0 -> 0, residuals of zeros are zero), so padding never leaks into
 codec statistics or aggregates and is simply dropped by :func:`unpack`.
+
+Dtype invariant: the packed buffer is ALWAYS float32 — the concat
+primitives (:func:`fedtpu.utils.trees.tree_concat_rows` /
+``tree_concat_flat``) cast every leaf on entry, and :func:`unpack` /
+:func:`unpack_stacked` restore original leaf dtypes from the layout table.
+Under ``compute_dtype=bfloat16_mixed`` deltas are taken against the f32
+master params, so aggregation, FedOpt, screening statistics and checkpoint
+wire bytes are bit-identical in layout to a pure-f32 run (pinned by
+``tests/test_mixed_precision.py``).
 """
 
 from __future__ import annotations

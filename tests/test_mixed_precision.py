@@ -1,0 +1,285 @@
+"""Mixed-precision device residency + client megabatching (perf fast path).
+
+The two fast-path levers (``FedConfig.compute_dtype='bfloat16_mixed'``,
+``FedConfig.megabatch_clients=k``) are PERF knobs with a precisely scoped
+numerics contract, pinned here:
+
+* ``megabatch_clients=1`` is BITWISE identical to the classic per-client
+  vmapped path — stepped and fused, gather and presharded layouts. The
+  masked-mean loss, group rng selection and wrapper reshapes are all exact
+  identities at k=1, so any bit of drift means the mega body diverged from
+  the reference body.
+* Under ``bfloat16_mixed`` the AGGREGATION SURFACE stays f32: server
+  params, optimizer state, the flat packed buffer and the checkpoint wire
+  bytes are identical in dtype/size to a float32 run. Only the on-device
+  compute/dataset residency changes.
+* ``augment_crop=False`` is flip-only with the SAME flip decisions as the
+  crop path (shared rng split structure).
+* bf16-vs-f32 convergence stays within a documented tolerance on the easy
+  synthetic task (the analogue of MOMENTUM_DTYPE_CONVERGENCE for the
+  compute dtype).
+* Misconfigurations fail loudly at construction, not silently mid-run.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RoundConfig,
+    resolve_compute_dtype,
+    validate_megabatch,
+)
+from fedtpu.core import Federation
+from fedtpu.data.augment import augment_batch
+
+
+def _cfg(layout="gather", compute="float32", mega=0, clients=4,
+         model="mlp", dataset="synthetic", augment=False, **kw):
+    base = dict(
+        model=model,
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset=dataset,
+            batch_size=4,
+            partition="iid",
+            num_examples=32 * clients,
+            augment=augment,
+            device_layout=layout,
+        ),
+        fed=FedConfig(
+            num_clients=clients,
+            compute_dtype=compute,
+            megabatch_clients=mega,
+        ),
+        steps_per_round=2,
+    )
+    base.update(kw)
+    return RoundConfig(**base)
+
+
+def _state_leaves(fed):
+    return (
+        jax.tree_util.tree_leaves(fed.state.params)
+        + jax.tree_util.tree_leaves(fed.state.batch_stats)
+        + jax.tree_util.tree_leaves(fed.state.opt_state)
+    )
+
+
+def _assert_bitwise(fa, fb):
+    for a, b in zip(_state_leaves(fa), _state_leaves(fb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(fa.state.last_client_loss),
+        np.asarray(fb.state.last_client_loss),
+    )
+
+
+# ------------------------------------------------------- megabatch parity
+@pytest.mark.parametrize("layout", ["gather", "presharded"])
+@pytest.mark.parametrize("fused", [False, True])
+def test_megabatch_k1_bitwise_identical(layout, fused):
+    """k=1 engages the FULL mega path (masked-mean loss, group wrapper,
+    broadcast/where recombination) against the classic path — the strongest
+    cheap correctness pin the k>1 modes inherit."""
+    fa = Federation(_cfg(layout=layout, mega=0), seed=0)
+    fb = Federation(_cfg(layout=layout, mega=1), seed=0)
+    if fused:
+        fa.run_on_device(2)
+        fb.run_on_device(2)
+    else:
+        for _ in range(2):
+            fa.step()
+            fb.step()
+    _assert_bitwise(fa, fb)
+
+
+def test_megabatch_k1_bitwise_with_augment_bn_dropout():
+    """Same pin through the full stochastic client body: augmentation rng,
+    BN batch stats and dropout all flow through the mega body's single
+    [k*batch] pass. cifar-shaped so the conv stack and augment engage."""
+    kw = dict(model="smallcnn", dataset="cifar10", augment=True,
+              layout="presharded", clients=2)
+    fa = Federation(_cfg(mega=0, **kw), seed=0)
+    fb = Federation(_cfg(mega=1, **kw), seed=0)
+    fa.step()
+    fb.step()
+    _assert_bitwise(fa, fb)
+
+
+def test_megabatch_k2_trains_and_keeps_per_client_metrics():
+    """k=2 is the documented-approximation regime: one group trajectory per
+    k clients. It must still learn and still report PER-CLIENT metrics at
+    the [num_clients] shape the sim/observability layers consume."""
+    fed = Federation(_cfg(mega=2, clients=4, steps_per_round=4), seed=0)
+    first = fed.run(num_rounds=1)
+    last = fed.run(num_rounds=5)
+    assert float(last.loss) < float(first.loss)
+    assert fed.state.last_client_loss.shape == (4,)
+
+
+# --------------------------------------------------- bf16 f32 surface pin
+def test_bf16_mixed_keeps_aggregation_surface_f32(tmp_path):
+    """bfloat16_mixed changes device residency, never server semantics:
+    master params/opt stay f32, the flat packed buffer stays f32, and a
+    checkpoint of the bf16-mode state is byte-for-byte the SIZE of the f32
+    mode's (the wire format must not notice the compute dtype)."""
+    from fedtpu.checkpoint.checkpoint import save
+    from fedtpu.ops import flat as flat_ops
+
+    f32 = Federation(_cfg(compute="float32"), seed=0)
+    b16 = Federation(_cfg(compute="bfloat16_mixed"), seed=0)
+    f32.step()
+    b16.step()
+
+    for leaf in jax.tree_util.tree_leaves(
+        (b16.state.params, b16.state.opt_state)
+    ):
+        assert leaf.dtype == jnp.float32
+    # Device-resident dataset IS stored bf16 (the HBM footprint win)...
+    assert b16._ensure_device_data()[0].dtype == jnp.bfloat16
+    assert f32._ensure_device_data()[0].dtype == jnp.float32
+
+    # ...but the flat aggregation buffer the screening/compression stack
+    # sees is structurally f32 either way.
+    lay = flat_ops.make_layout(jax.device_get(b16.state.params))
+    packed = flat_ops.pack(lay, b16.state.params)
+    assert packed.dtype == jnp.float32
+
+    # Checkpoint wire: identical byte count between the two modes.
+    p32 = save(str(tmp_path / "f32"), 0, jax.device_get(f32.state))
+    p16 = save(str(tmp_path / "b16"), 0, jax.device_get(b16.state))
+    assert os.path.getsize(p32) == os.path.getsize(p16)
+
+
+def test_bf16_convergence_within_documented_tolerance():
+    """The compute-dtype analogue of MOMENTUM_DTYPE_CONVERGENCE: bf16
+    training tracks f32 on the easy synthetic task. Tolerance is loose by
+    design — bf16 has ~8 mantissa bits and the trajectories genuinely
+    diverge — but both must LEARN, and the final losses must agree to 25%
+    relative (measured headroom ~5x on this config)."""
+    losses = {}
+    for compute in ("float32", "bfloat16_mixed"):
+        fed = Federation(
+            _cfg(compute=compute, clients=2, steps_per_round=4), seed=0
+        )
+        first = fed.run(num_rounds=1)
+        last = fed.run(num_rounds=3)
+        assert float(last.loss) < float(first.loss)
+        losses[compute] = float(last.loss)
+    # 25% relative with a small absolute floor: the synthetic task drives
+    # the loss to ~0, where a relative bound alone is ill-conditioned.
+    diff = abs(losses["bfloat16_mixed"] - losses["float32"])
+    assert diff < max(0.25 * losses["float32"], 0.05), losses
+
+
+# -------------------------------------------------------- crop toggle pin
+def test_crop_off_is_flip_only_with_identical_flip_draws():
+    """augment_crop=False must change ONLY the crop: the flip decisions
+    come from the same split(rng) slot in both modes, so crop-off output
+    equals a hand-built flip using that slot — and flipping a crop=True
+    output uses the same mask (mode-coupled determinism)."""
+    rng = jax.random.PRNGKey(3)
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 32, 32, 3), jnp.float32)
+    _crop_rng, flip_rng = jax.random.split(rng)
+    flip = jax.random.bernoulli(flip_rng, 0.5, (8,))
+    expect = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    got = augment_batch(rng, x, crop=False)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))
+    assert bool(np.asarray(flip).any()) and not bool(np.asarray(flip).all())
+
+
+def test_crop_flag_flows_from_data_config():
+    """DataConfig.augment_crop=False is bit-identical to flip-only through
+    the engine; crop on-vs-off genuinely differ (the flag is not dead)."""
+    kw = dict(model="smallcnn", dataset="cifar10", augment=True, clients=2)
+    on = Federation(_cfg(**kw), seed=0)
+    off = Federation(
+        _cfg(**kw, data=dataclasses.replace(
+            _cfg(**kw).data, augment_crop=False)),
+        seed=0,
+    )
+    on.step()
+    off.step()
+    a = jax.tree_util.tree_leaves(on.state.params)
+    b = jax.tree_util.tree_leaves(off.state.params)
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a, b)
+    )
+
+
+# ------------------------------------------------------------- validation
+def test_megabatch_must_divide_cohort():
+    with pytest.raises(ValueError, match="divide"):
+        validate_megabatch(FedConfig(num_clients=4, megabatch_clients=3))
+    with pytest.raises(ValueError, match="divide"):
+        Federation(_cfg(mega=3, clients=4), seed=0)
+    with pytest.raises(ValueError, match=">= 0"):
+        validate_megabatch(FedConfig(num_clients=4, megabatch_clients=-1))
+
+
+def test_unknown_compute_dtype_rejected_cheaply():
+    with pytest.raises(ValueError, match="compute_dtype"):
+        resolve_compute_dtype(_cfg(compute="float16"))
+    with pytest.raises(ValueError, match="compute_dtype"):
+        Federation(_cfg(compute="bf16"), seed=0)
+
+
+def test_megabatch_rejects_debug_per_batch():
+    with pytest.raises(ValueError, match="debug_per_batch"):
+        fed = Federation(_cfg(mega=2, debug_per_batch=True), seed=0)
+        fed.step()
+
+
+# --------------------------------------------------------- CLI perf knobs
+def test_perf_preset_resolution():
+    """--perf-preset fast fills only the knobs the user left unset; parity
+    and no-preset leave the dataclass defaults (f32, megabatching off) in
+    charge; an odd cohort degrades megabatching to off, not to a crash."""
+    import argparse
+
+    from fedtpu.cli.common import add_perf_flags, resolve_perf_preset
+
+    def parse(argv):
+        p = argparse.ArgumentParser()
+        add_perf_flags(p)
+        return p.parse_args(argv)
+
+    assert resolve_perf_preset(parse([]), 64) == ("float32", 0)
+    assert resolve_perf_preset(
+        parse(["--perf-preset", "parity"]), 64) == ("float32", 0)
+    assert resolve_perf_preset(
+        parse(["--perf-preset", "fast"]), 64) == ("bfloat16_mixed", 8)
+    assert resolve_perf_preset(
+        parse(["--perf-preset", "fast"]), 6) == ("bfloat16_mixed", 2)
+    assert resolve_perf_preset(
+        parse(["--perf-preset", "fast"]), 3) == ("bfloat16_mixed", 0)
+    # Explicit flags beat the preset.
+    assert resolve_perf_preset(
+        parse(["--perf-preset", "fast", "--compute-dtype", "float32",
+               "--megabatch-clients", "4"]), 64) == ("float32", 4)
+
+
+def test_build_config_threads_perf_knobs():
+    import argparse
+
+    from fedtpu.cli import common
+
+    p = argparse.ArgumentParser()
+    common.add_model_flags(p)
+    common.add_fed_flags(p)
+    args = p.parse_args(
+        ["--dataset", "synthetic", "--batch-size", "4",
+         "--num-examples", "64", "--perf-preset", "fast"])
+    cfg = common.build_config(args, num_clients=8, steps_per_round=2)
+    assert cfg.fed.compute_dtype == "bfloat16_mixed"
+    assert cfg.fed.megabatch_clients == 8

@@ -95,6 +95,86 @@ def test_analytic_flops_agrees_with_xla_on_matmul():
         assert got == pytest.approx(xla, rel=0.05)
 
 
+def test_analytic_bytes_sees_dtype_and_skips_layout_ops():
+    """analytic_bytes is the backend-independent byte model behind the
+    mixed-precision microbench: fusion-group boundary bytes at the STATED
+    aval dtypes (so bf16 halves traffic even where a CPU backend would
+    emulate in f32), with pure layout ops (reshape/broadcast/transpose)
+    free."""
+    import jax.numpy as jnp
+
+    from fedtpu.obs.profile import analytic_bytes
+
+    def f(a, b):
+        return a @ b
+
+    a32 = jnp.ones((64, 128), jnp.float32)
+    b32 = jnp.ones((128, 32), jnp.float32)
+    got = analytic_bytes(f, a32, b32)
+    # in (64*128 + 128*32) + out (64*32), 4 bytes each.
+    assert got == (64 * 128 + 128 * 32 + 64 * 32) * 4
+    a16, b16 = a32.astype(jnp.bfloat16), b32.astype(jnp.bfloat16)
+    assert analytic_bytes(f, a16, b16) == got / 2
+
+    def g(a, b):
+        # The reshape/broadcast shuffle must add NOTHING over f.
+        return a.reshape(64, 128) @ jnp.broadcast_to(b, b.shape)
+
+    assert analytic_bytes(g, a32.reshape(128, 64), b32) == got
+
+
+def test_analytic_bytes_fuses_elementwise_chains():
+    """The model charges fusion-GROUP boundaries, not per-eqn I/O: a chain
+    of elementwise ops is one pass over the data (intermediates are
+    register traffic), and a reduction fuses with its producers but its
+    output materializes. Without this, the f32 intermediates of e.g. a
+    BatchNorm statistics chain would be charged at 5x activation size —
+    biasing the model against the bf16 residency lever it exists to
+    measure (tests the rationale in fedtpu/obs/profile.py)."""
+    import jax.numpy as jnp
+
+    from fedtpu.obs.profile import analytic_bytes
+
+    a = jnp.ones((256, 128), jnp.float32)
+    b = jnp.ones((256, 128), jnp.float32)
+
+    def chain(a, b):
+        return jnp.exp(a) * b + a
+
+    # ONE group: reads {a, b}, writes {out} — the exp/mul intermediates
+    # never count, and a's two uses inside the group charge once.
+    n = 256 * 128 * 4
+    assert analytic_bytes(chain, a, b) == 3 * n
+
+    def stat(a):
+        # square-then-reduce (the BN statistics shape): the reduce fuses
+        # with its producers, so the whole chain is reads {a} + the tiny
+        # reduced write.
+        return jnp.square(a).sum(axis=0)
+
+    assert analytic_bytes(stat, a) == n + 128 * 4
+
+    def reduce_then_use(a):
+        # A reduction OUTPUT materializes: its consumer starts a new pass,
+        # re-reading both the reduced row and the full input.
+        s = a.sum(axis=0)
+        return a * s
+
+    # group1 {sum}: read a, write s; group2 {mul}: read a + s, write out.
+    assert analytic_bytes(reduce_then_use, a) == 2 * n + 2 * (128 * 4) + n
+
+
+def test_cost_model_carries_analytic_bytes():
+    cm = CostModel(xla_flops=1e10, xla_bytes=1e9, analytic=1.0e10,
+                   analytic_bytes=8e8)
+    assert cm.analytic_bytes == 8e8
+    assert cm.as_dict()["analytic_bytes_per_round"] == 8e8
+    # Optional: absent stays schema-stable None.
+    cm = CostModel(xla_flops=None, xla_bytes=None, analytic=5e9)
+    assert cm.analytic_bytes is None
+    assert cm.as_dict()["analytic_bytes_per_round"] is None
+
+
 # ----------------------------------------------------------- round profiler
 def test_round_profiler_gauges_and_record_fields(monkeypatch):
     monkeypatch.setenv("FEDTPU_PEAK_FLOPS", "1e12")
@@ -418,6 +498,42 @@ def test_gap_analyze_tolerates_timeline_without_device_ops():
     assert report["device_busy_us"] == 0.0
 
 
+def test_gap_analyze_roofline_stamp(tmp_path):
+    """--roofline: recomputes placement from a profile artifact's
+    flops/bytes rows through obs.profile.roofline — the gap report then
+    answers both idle attribution AND what the busy time is limited by."""
+    profile = {
+        "configs": [{
+            "batch": 128, "device_kind": "TPU v5 lite",
+            "flops_per_round": 276329529344.0,
+            "bytes_per_round": 14553602048.0,
+            "rounds_per_sec": 9.333, "mfu": 0.0131,
+        }]
+    }
+    path = tmp_path / "profile.json"
+    path.write_text(json.dumps(profile))
+    stamp = gap_analyze.roofline_stamp(str(path))
+    assert stamp["profile_artifact"] == str(path)
+    (row,) = stamp["rows"]
+    assert row["roofline_bound"] == "bandwidth"
+    assert row["arith_intensity_flops_per_byte"] == pytest.approx(
+        18.99, abs=0.01)
+    assert row["ridge_point_flops_per_byte"] == pytest.approx(
+        240.54, abs=0.01)
+    # Achieved rate present -> utilization filled (the r04 hbm_util ~0.166).
+    assert row["roofline_utilization"] == pytest.approx(0.166, abs=0.01)
+    # Flat dict (microbench analytic row) also accepted; no achieved rate
+    # -> utilization stays None.
+    flat = tmp_path / "flat.json"
+    flat.write_text(json.dumps({
+        "flops_per_round": 1e9, "bytes_per_round": 1e9,
+        "device_kind": "TPU v5 lite",
+    }))
+    (frow,) = gap_analyze.roofline_stamp(str(flat))["rows"]
+    assert frow["roofline_bound"] == "bandwidth"
+    assert frow["roofline_utilization"] is None
+
+
 def test_gap_report_committed_artifact_contract():
     """The committed GAP_REPORT.json came from a real --profile-rounds
     densenet CPU capture piped through trace_merge --device-trace."""
@@ -557,7 +673,7 @@ def test_perf_baseline_committed_artifact_contract():
         "calibration_us", "span_trace_us", "counter_inc_us", "gauge_set_us",
         "histogram_observe_us", "mfu_observe_us", "latency_summary_us",
         "round_record_us", "prometheus_render_us", "trace_merge_us",
-        "gap_analyze_us",
+        "gap_analyze_us", "mixed_precision_cast_us", "megabatch_reshape_us",
     }
     assert set(baseline["metrics"]) == expected
     for row in baseline["metrics"].values():

@@ -154,6 +154,58 @@ def test_script_and_bench_jobs_expose_guards_and_env(watch):
             )
 
 
+def test_bench_job_mfu_gate(watch, monkeypatch):
+    """min_mfu makes measured MFU part of the pass condition: a capture
+    below the floor is BANKED (evidence either way) but the leg fails and
+    stays pending for a retried window; at-or-above passes and stamps the
+    gate verdict on the artifact."""
+    import json
+
+    mfu = [0.05]
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+
+        @property
+        def stdout(self):
+            return json.dumps(
+                {"value": 42.0, "unit": "client-epochs/sec/chip",
+                 "mfu": mfu[0]})
+
+    monkeypatch.setattr(
+        watch.subprocess, "run", lambda *a, **kw: _Proc())
+    job = watch._bench_job(
+        "GATED.json", min_mfu=0.10,
+        env={"FEDTPU_COMPUTE_DTYPE": "bfloat16_mixed"})
+
+    ok, detail = job()
+    assert ok is False and "mfu gate FAILED" in detail
+    with open(os.path.join(watch.ART, "GATED.json")) as fh:
+        banked = json.load(fh)
+    assert banked["mfu_gate"] == {"min_mfu": 0.10, "passed": False}
+    assert banked["captured_env"]["FEDTPU_COMPUTE_DTYPE"] == "bfloat16_mixed"
+
+    mfu[0] = 0.12
+    ok, detail = job()
+    assert ok is True
+    with open(os.path.join(watch.ART, "GATED.json")) as fh:
+        assert json.load(fh)["mfu_gate"] == {"min_mfu": 0.10, "passed": True}
+
+
+def test_queue_carries_bf16_megabatch_leg_with_mfu_gate(watch):
+    """The mixed-precision PR's on-chip verdict is queued: bf16+megabatch
+    env knobs with the ISSUE's >=10% MFU pass condition."""
+    jobs = dict(watch.JOBS)
+    leg = jobs["bench_bf16mega_r07"]
+    assert leg.min_mfu == 0.10
+    assert leg.env == {"FEDTPU_COMPUTE_DTYPE": "bfloat16_mixed",
+                       "FEDTPU_MEGABATCH_CLIENTS": "8"}
+    assert leg.budget_s <= 360
+    # Gated experiment legs never displace the guaranteed headline capture.
+    assert [n for n, _ in watch.JOBS][0] == "bench_fused_r06"
+
+
 def test_queue_is_driver_bench_first_with_hard_budgets(watch):
     """Round-6 queue shape (VERDICT r5 "Next round" #1): the driver-path
     headline bench is job #1 with a ~5-minute hard budget, and EVERY job

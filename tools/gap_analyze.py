@@ -22,12 +22,23 @@ Import-free of fedtpu (stdlib only), like the other ``tools/`` readers.
 
 Usage:
     python tools/gap_analyze.py merged.json -o artifacts/GAP_REPORT.json \
-        [--top 10] [--min-gap-us 100] [--check]
+        [--top 10] [--min-gap-us 100] [--check] \
+        [--roofline artifacts/MFU_PROFILE_r04.json]
 
 ``--check`` exits non-zero when the timeline has no device lane (the
 acceptance gate for a --profile-rounds capture that silently produced no
 device ops). An EMPTY gap list is not a failure — a fully-busy device is
 the goal state.
+
+``--roofline PROFILE`` additionally stamps roofline placement onto the
+report: for each config row in an ``--mfu-profile`` artifact (or a flat
+dict carrying ``flops_per_round``/``bytes_per_round``) it recomputes
+arithmetic intensity, ridge point, bound and utilization through
+``fedtpu.obs.profile.roofline``, so one report answers both "where does
+the idle time go" (gaps) and "what is the busy time limited by"
+(roofline). This is the only path that imports fedtpu — it is loaded
+lazily inside the flag handler so the default invocation stays stdlib
+only.
 """
 
 from __future__ import annotations
@@ -225,6 +236,49 @@ def analyze(
     return report
 
 
+def roofline_stamp(profile_path: str) -> dict:
+    """Roofline placement rows for every config in a profile artifact.
+
+    Accepts the ``--mfu-profile`` schema (``{"configs": [...]}`` where each
+    row has ``flops_per_round``/``bytes_per_round``/``device_kind`` and
+    usually ``rounds_per_sec``) or a flat dict with the same per-row keys.
+    Peaks resolve through ``fedtpu.obs.profile.device_peaks`` (honouring
+    the ``FEDTPU_PEAK_*`` env overrides); utilization is filled when the
+    row carries an achieved rate. Imports fedtpu lazily — see module
+    docstring."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from fedtpu.obs.profile import device_peaks, roofline
+
+    doc = load_doc(profile_path)
+    rows = doc.get("configs") if isinstance(doc.get("configs"), list) else [doc]
+    out_rows = []
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        flops = row.get("flops_per_round")
+        nbytes = row.get("bytes_per_round")
+        if flops is None and nbytes is None:
+            continue
+        peak_f, peak_b = device_peaks(row.get("device_kind") or "")
+        achieved = None
+        if flops and row.get("rounds_per_sec"):
+            achieved = flops * row["rounds_per_sec"]
+        placement = roofline(flops, nbytes, peak_f, peak_b, achieved)
+        out_rows.append({
+            "batch": row.get("batch"),
+            "device_kind": row.get("device_kind"),
+            "flops_per_round": flops,
+            "bytes_per_round": nbytes,
+            "mfu": row.get("mfu"),
+            **placement,
+        })
+    return {
+        "profile_artifact": profile_path,
+        "rows": out_rows,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     p.add_argument("merged", help="trace_merge.py output with device lanes")
@@ -236,11 +290,17 @@ def main(argv=None) -> int:
                    help="ignore device-idle gaps shorter than this")
     p.add_argument("--check", action="store_true",
                    help="fail when the timeline has no device lane at all")
+    p.add_argument("--roofline", default=None, metavar="PROFILE",
+                   help="stamp roofline placement (bound / intensity / "
+                        "utilization) from this --mfu-profile artifact "
+                        "onto the report (imports fedtpu lazily)")
     args = p.parse_args(argv)
 
     report = analyze(
         load_doc(args.merged), top=args.top, min_gap_us=args.min_gap_us
     )
+    if args.roofline:
+        report["roofline"] = roofline_stamp(args.roofline)
     text = json.dumps(report, indent=2)
     if args.out:
         with open(args.out, "w") as fh:
@@ -260,6 +320,16 @@ def main(argv=None) -> int:
         ),
         file=sys.stderr,
     )
+    rl = report.get("roofline", {}).get("rows") or []
+    if rl:
+        r0 = rl[0]
+        print(
+            f"roofline: {r0['roofline_bound']} bound, "
+            f"AI {r0['arith_intensity_flops_per_byte']} vs ridge "
+            f"{r0['ridge_point_flops_per_byte']} "
+            f"({len(rl)} config rows stamped)",
+            file=sys.stderr,
+        )
     if args.check and report["device_lanes"] == 0:
         print("CHECK FAILED: no device lane in the merged timeline "
               "(merge with --device-trace)", file=sys.stderr)

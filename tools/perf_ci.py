@@ -99,6 +99,8 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
     harness runs in a couple of seconds and is safe for tier-1."""
     import gap_analyze
     import trace_merge
+    import numpy as np
+    import ml_dtypes
     from fedtpu.obs import (
         RoundRecordWriter,
         Telemetry,
@@ -144,6 +146,28 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
         "metadata": {"wall_start": 1000.0, "role": "engine"},
     }
 
+    # Mixed-precision host costs (PR: bf16 device residency + megabatch).
+    # These are the ONLY host-side steps the compute_dtype/megabatch knobs
+    # add outside the jitted round: the one-time f32 -> bf16 master-copy
+    # cast at device upload, and the [clients] -> [groups, k*batch] static
+    # regrouping reshape. Both must stay trivially cheap — a regression
+    # here means someone moved the cast/regroup out of XLA into a per-round
+    # host loop. numpy + ml_dtypes stand in for the jitted versions so the
+    # harness stays jax-free and seconds-scale.
+    cast_src = np.ones((64, 4096), dtype=np.float32)
+
+    def cast_one():
+        cast_src.astype(ml_dtypes.bfloat16)
+
+    mega_src = np.ones((8, 32, 32, 32, 3), dtype=np.float32)  # [C,B,H,W,ch]
+
+    def megabatch_reshape_one():
+        # Group k=4 clients -> [G, k*B, H, W, ch]. The contiguous [clients]
+        # axis makes this a VIEW (sub-microsecond) — exactly the claim in
+        # validate_megabatch's error message; this metric pins that nobody
+        # replaces it with a gather/copy regroup.
+        np.ascontiguousarray(mega_src.reshape(2, 4 * 32, 32, 32, 3))
+
     def span_one():
         with tel.span("perf_ci", round=0):
             pass
@@ -170,6 +194,8 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
          lambda: trace_merge.merge_docs([host_doc], device_docs=[dev_doc]),
          50, None),
         ("gap_analyze_us", lambda: gap_analyze.analyze(doc), 20, None),
+        ("mixed_precision_cast_us", cast_one, 200, None),
+        ("megabatch_reshape_us", megabatch_reshape_one, 5000, None),
     ]
 
 

@@ -91,15 +91,21 @@ def probe(timeout_s):
     return True, proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "ok"
 
 
-def _bench_job(artifact, env=None, budget_s=300):
+def _bench_job(artifact, env=None, budget_s=300, min_mfu=None):
     """Run bench.py; success = a JSON line with value > 0, saved as the live
     artifact (bench.py itself is already subprocess-isolated + bounded).
     ``env`` selects a variant leg (FEDTPU_BENCH_MODEL / FEDTPU_MOMENTUM_DTYPE
-    — see bench.py); the default is the driver's exact parity run.
+    / FEDTPU_COMPUTE_DTYPE / FEDTPU_MEGABATCH_CLIENTS — see bench.py); the
+    default is the driver's exact parity run.
     ``budget_s`` is the job's HARD wall-clock budget: a healthy window
     completes the measurement in ~2-4 min (persistent compile cache), so a
     job past its budget means the tunnel re-wedged — kill it and keep the
-    window for the rest of the queue (VERDICT r5 "Next round" #1)."""
+    window for the rest of the queue (VERDICT r5 "Next round" #1).
+    ``min_mfu`` makes the measured MFU part of the pass condition: the leg
+    FAILS (and re-queues for the next window) when the capture's ``mfu``
+    field is missing or below the floor — for legs whose whole point is an
+    MFU claim (the bf16+megabatch >= 10% gate), a capture below the gate is
+    a negative result, not a success."""
     def run():
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -113,6 +119,22 @@ def _bench_job(artifact, env=None, budget_s=300):
             return False, f"no JSON from bench.py (rc={proc.returncode})"
         if line.get("value", 0) <= 0:
             return False, f"bench diagnostic: {line.get('error', line)}"
+        if min_mfu is not None:
+            mfu = line.get("mfu")
+            if not isinstance(mfu, (int, float)) or mfu < min_mfu:
+                # Still bank the capture (it is evidence either way) but do
+                # not mark the gate passed.
+                line["mfu_gate"] = {"min_mfu": min_mfu, "passed": False}
+                line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                line["captured_by"] = "tools/tpu_watch.py"
+                if env:
+                    line["captured_env"] = dict(env)
+                atomic_write(
+                    os.path.join(ART, artifact), json.dumps(line, indent=2))
+                return False, (
+                    f"mfu gate FAILED: mfu={mfu} < {min_mfu} "
+                    f"(capture saved to {artifact})")
+            line["mfu_gate"] = {"min_mfu": min_mfu, "passed": True}
         line["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
         # Provenance keys on the ARTIFACT name (jobs carry their round in
         # the filename); the watcher itself is round-agnostic.
@@ -122,6 +144,8 @@ def _bench_job(artifact, env=None, budget_s=300):
         atomic_write(os.path.join(ART, artifact), json.dumps(line, indent=2))
         return True, f"value={line['value']} {line.get('unit', '')} mfu={line.get('mfu')}"
     run.budget_s = budget_s
+    run.env = dict(env) if env else {}
+    run.min_mfu = min_mfu
     return run
 
 
@@ -180,7 +204,18 @@ JOBS = [
     ("bench_fused40",
      _bench_job("BENCH_LIVE_r06_fused40.json", budget_s=300,
                 env={"FEDTPU_BENCH_TIMED_ROUNDS": "40"})),
-    # 8: the long acc-full parity run, LAST — it only fires in a window
+    # 8 (round 7, 2026-08-05): the mixed-precision tentpole's on-chip
+    # verdict — bf16 device residency + megabatched MXU passes, the two
+    # levers the analytic model says cut bytes_per_round >= 1.8x
+    # (artifacts/MIXED_PRECISION_MICROBENCH.json). Pass condition is the
+    # ISSUE's acceptance gate: measured MFU >= 10% (vs the 1.31% f32
+    # headline). A capture below the gate is banked as evidence but the
+    # leg stays pending for a retuned retry.
+    ("bench_bf16mega_r07",
+     _bench_job("BENCH_LIVE_r07_bf16mega.json", budget_s=300, min_mfu=0.10,
+                env={"FEDTPU_COMPUTE_DTYPE": "bfloat16_mixed",
+                     "FEDTPU_MEGABATCH_CLIENTS": "8"})),
+    # 9: the long acc-full parity run, LAST — it only fires in a window
     # that has already banked everything above, and its budget caps the
     # worst case at ~25 min instead of wedging the whole window.
     ("acc_full_fedtpu",

@@ -2097,6 +2097,273 @@ def _backend_reachable():
     return False, f"{PROBE_ATTEMPTS} attempts; last: {last}"
 
 
+def _fanin_microbench():
+    """``fanin_microbench``: does the hierarchical root's per-round work
+    scale with AGGREGATORS or with CLIENTS?
+
+    Drives up to 10k simulated clients/round through a real 2-tier
+    topology: leaf :class:`fedtpu.transport.aggregator.AggregatorServer`
+    processes serve SubmitPartial over REAL localhost gRPC, each backed by
+    a SimFederation-style cohort (``fedtpu.sim`` Population + uniform
+    cohort sampler draws which virtual clients participate; only the local
+    TRAINING is simulated — every reply payload runs the genuine FSP1
+    encode -> stream decode -> partial-reduce -> SubmitPartial path). The
+    root side mirrors tier-mode ``_round_body``: one SubmitPartial pull
+    per aggregator, ``sparse.decode_into_row`` into the ``[A, P]`` buffer,
+    ``flat_ops.combine_partial_rows`` finalize.
+
+    Single-core honesty: this box serialises the leaves (no parallelism to
+    measure), so the artifact reports BOTH walls —
+
+    - ``serial_wall_s``: everything end-to-end as measured here;
+    - ``critical_path_s``: root decode+combine + the SLOWEST single
+      leaf's measured duration — the round wall of the deployed topology,
+      where leaves run on their own hosts;
+
+    and records ``host_cores`` so a reader can tell which wall binds.
+    Two sweeps, two gates (mirrored by tests/test_bench.py):
+
+    - scale-out (fixed cohort, growing aggregators): critical-path
+      growth exponent vs total clients < 1 -> round wall SUBLINEAR in
+      clients;
+    - fan-in (fixed aggregators, growing cohorts): root decode+combine
+      flat (<2x) across 4x client growth -> root work O(aggregators),
+      not O(clients).
+
+    Run via ``python bench.py --fanin-microbench``; prints one JSON line
+    and writes artifacts/FANIN_MICROBENCH.json atomically.
+    """
+    import gc
+    import math
+    import socket
+
+    import numpy as np
+
+    from fedtpu.config import FedConfig, RoundConfig
+    from fedtpu.ops import flat as flat_ops
+    from fedtpu.sim.population import Population
+    from fedtpu.sim.samplers import UniformSampler
+    from fedtpu.transport import proto, sparse
+    from fedtpu.transport.aggregator import serve_aggregator
+    from fedtpu.transport.service import TrainerStub, create_channel
+
+    # Synthetic flat surface: ~32k f32 coordinates (the small-model zoo's
+    # scale), padded by the layout to the 128 lane.
+    dim = int(os.environ.get("FEDTPU_FB_DIM", "32768"))
+    template = {
+        "params": {"w": np.zeros((dim // 128, 128), np.float32)},
+        "batch_stats": {},
+    }
+    layout = flat_ops.make_layout(template)
+    # Sweep 1 (scale-out): cohort size fixed, aggregator count grows —
+    # 8 x 1250 = the 10k-clients/round headline. Sweep 2 (fan-in): 4
+    # aggregators, cohort grows 4x.
+    cohort_fixed = int(os.environ.get("FEDTPU_FB_COHORT", "1250"))
+    agg_counts = [
+        int(a) for a in
+        os.environ.get("FEDTPU_FB_AGGS", "2,4,8").split(",")
+    ]
+    fixed_aggs = int(os.environ.get("FEDTPU_FB_FIXED_AGGS", "4"))
+    growing_cohorts = [
+        int(c) for c in
+        os.environ.get(
+            "FEDTPU_FB_COHORTS",
+            f"{cohort_fixed // 4},{cohort_fixed // 2},{cohort_fixed}",
+        ).split(",")
+    ]
+    rounds = int(os.environ.get("FEDTPU_FB_ROUNDS", "4"))
+    # Distinct payload templates per leaf: decode cost is content-
+    # independent, so cycling K real encoded payloads per cohort keeps the
+    # (client-side, unmeasured) encode cost off the bench's clock while
+    # every decode is the genuine path.
+    distinct = int(os.environ.get("FEDTPU_FB_DISTINCT_PAYLOADS", "8"))
+
+    cfg = RoundConfig(
+        fed=FedConfig(
+            num_clients=2, delta_layout="flat", telemetry="off",
+        ),
+    )
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def make_cohort_source(leaf_idx: int, cohort: int, population: int):
+        """SimFederation-backed downstream: the Population + sampler pick
+        the round's virtual cohort; each member's reply is a real FSP1
+        flat payload carrying its example count."""
+        shard = np.zeros((population, 1), np.int32)
+        pop = Population(shard, np.ones_like(shard, bool), seed=leaf_idx)
+        sampler = UniformSampler(seed=leaf_idx)
+        rng = np.random.default_rng(1000 + leaf_idx)
+        payloads = []
+        for i in range(distinct):
+            delta = {
+                "params": {
+                    "w": rng.standard_normal(
+                        (dim // 128, 128)
+                    ).astype(np.float32)
+                },
+                "batch_stats": {},
+            }
+            data, _ = sparse.encode_topk_flat(
+                delta, 1.0,
+                extra={"num_examples": np.float32(32 + i)},
+            )
+            payloads.append(data)
+
+        def source(round_idx: int, rank_base: int, world: int):
+            ids, alive = sampler.sample(pop, round_idx, cohort)
+            return [
+                payloads[int(cid) % distinct]
+                for cid, ok in zip(ids, alive) if ok
+            ]
+
+        return source
+
+    def run_topology(num_aggs: int, cohort: int) -> dict:
+        """One 2-tier configuration: real-gRPC leaves, root-side pull +
+        decode + combine loop; returns post-warmup per-round medians."""
+        servers, aggs, stubs = [], [], []
+        for j in range(num_aggs):
+            addr = f"localhost:{free_port()}"
+            srv, agg = serve_aggregator(
+                addr, cfg,
+                cohort_source=make_cohort_source(
+                    j, cohort, population=4 * cohort
+                ),
+                template=template,
+            )
+            servers.append(srv)
+            aggs.append(agg)
+            stubs.append(TrainerStub(create_channel(addr)))
+        world = num_aggs * cohort
+        rows = np.zeros((num_aggs, layout.padded), np.float32)
+        serial, critical, root_work, leaf_max = [], [], [], []
+        clients_seen = 0
+        try:
+            for r in range(rounds):
+                t0 = time.monotonic()
+                leaf_walls, records = [], []
+                weight_sums = np.zeros((num_aggs,), np.float32)
+                clients_seen = 0
+                # Collect phase: pull every leaf's partial first, so the
+                # root-phase timing below never overlaps leaf serving.
+                for j, stub in enumerate(stubs):
+                    t_leaf = time.monotonic()
+                    reply = stub.SubmitPartial(
+                        proto.SubmitPartialRequest(
+                            rank_base=j * cohort, world=world,
+                            round=r, epoch=1,
+                        ),
+                        timeout=600,
+                    )
+                    leaf_walls.append(time.monotonic() - t_leaf)
+                    clients_seen += reply.clients
+                    records.append(reply.record)
+                # Root phase, isolated: everything above shares this one
+                # core with the in-process leaves, and their per-round
+                # garbage ([cohort, P] buffers, decoded payloads) would
+                # otherwise bill its GC pauses to the root's clock — an
+                # artifact of the single-host harness, not of the deployed
+                # topology (leaves collect on their own hosts).
+                gc.collect()
+                t_root = time.monotonic()
+                for j, record in enumerate(records):
+                    extra = sparse.decode_into_row(
+                        record, layout.sizes, rows[j]
+                    )
+                    weight_sums[j] = float(extra["weight_sum"])
+                mean_row = flat_ops.combine_partial_rows(
+                    jnp.asarray(rows), jnp.asarray(weight_sums)
+                )
+                jax.block_until_ready(mean_row)
+                t_end = time.monotonic()
+                root_s = t_end - t_root
+                serial.append(t_end - t0)
+                root_work.append(root_s)
+                leaf_max.append(max(leaf_walls))
+                critical.append(root_s + max(leaf_walls))
+        finally:
+            for a in aggs:
+                a.stop()
+            for s in servers:
+                s.stop(0)
+        # Drop round 0 (combine jit warm-up) when more than one round ran;
+        # medians, not means — a single-core box shares the clock with the
+        # in-process leaves, so per-round tails are scheduler noise.
+        sl = slice(1, None) if rounds > 1 else slice(None)
+        return {
+            "aggregators": num_aggs,
+            "cohort": cohort,
+            "clients": clients_seen,
+            "serial_wall_s": round(float(np.median(serial[sl])), 6),
+            "critical_path_s": round(float(np.median(critical[sl])), 6),
+            "root_decode_combine_s": round(
+                float(np.median(root_work[sl])), 6
+            ),
+            "leaf_max_s": round(float(np.median(leaf_max[sl])), 6),
+        }
+
+    import jax
+    import jax.numpy as jnp
+
+    scale_out = [run_topology(a, cohort_fixed) for a in agg_counts]
+    fan_in = [run_topology(fixed_aggs, c) for c in growing_cohorts]
+
+    # Gate 1: critical-path growth exponent vs clients < 1 (sublinear).
+    lo, hi = scale_out[0], scale_out[-1]
+    exponent = (
+        math.log(hi["critical_path_s"] / lo["critical_path_s"])
+        / math.log(hi["clients"] / lo["clients"])
+        if hi["clients"] > lo["clients"] and lo["critical_path_s"] > 0
+        else 0.0
+    )
+    # Gate 2: root decode+combine flat across the cohort growth.
+    flo, fhi = fan_in[0], fan_in[-1]
+    root_ratio = (
+        fhi["root_decode_combine_s"] / flo["root_decode_combine_s"]
+        if flo["root_decode_combine_s"] > 0 else 1.0
+    )
+    client_ratio = (
+        fhi["clients"] / flo["clients"] if flo["clients"] else 1.0
+    )
+    result = {
+        "metric": "fanin_microbench",
+        "unit": "seconds (post-warmup per-round medians; see sweeps)",
+        # Headline: the scale-out sweep's critical-path growth exponent —
+        # < 1.0 means round wall-clock is sublinear in total clients.
+        "value": round(exponent, 4),
+        "max_clients_per_round": max(r["clients"] for r in scale_out),
+        "flat_coords": int(layout.total),
+        "host_cores": os.cpu_count(),
+        "rounds_per_config": rounds,
+        "sweeps": {
+            "scale_out_fixed_cohort": scale_out,
+            "fan_in_fixed_aggregators": fan_in,
+        },
+        "gates": {
+            "critical_path_exponent_vs_clients": round(exponent, 4),
+            "critical_path_sublinear": bool(exponent < 1.0),
+            "root_work_ratio_across_cohort_growth": round(root_ratio, 4),
+            "root_client_growth_ratio": round(client_ratio, 4),
+            # Root work must stay far from tracking the 4x client growth.
+            "root_work_o_aggregators": bool(root_ratio < 2.0),
+        },
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "FANIN_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 def _print_diag(error: str) -> None:
     """Emit the value-0.0 diagnostic line (with the live-artifact pointer)."""
     diag = {
@@ -2148,6 +2415,9 @@ def main():
         return
     if "--mixed-precision-microbench" in sys.argv:
         print(json.dumps(_mixed_precision_microbench()))
+        return
+    if "--fanin-microbench" in sys.argv:
+        print(json.dumps(_fanin_microbench()))
         return
     if "--inner" in sys.argv:
         print(json.dumps(_measure()))

@@ -160,6 +160,22 @@ def add_fed_flags(p: argparse.ArgumentParser) -> None:
         "(see docs/PERF_ANALYSIS.md). Ignored by the simulated engine",
     )
     p.add_argument(
+        "--tier-fanout",
+        default=0,
+        type=int,
+        metavar="N",
+        help="hierarchical multi-tier aggregation "
+        "(docs/ARCHITECTURE.md §Multi-tier): 0 = flat one-tier federation "
+        "(default). N >= 1 makes the primary the ROOT of a two-tier "
+        "topology whose --clients entries are sub-aggregator addresses "
+        "(fedtpu.cli.server --role aggregator), each fronting a cohort of "
+        "up to N clients; the root pulls ONE pre-weighted partial sum per "
+        "aggregator per round, so its decode+combine work scales with "
+        "aggregators, not clients. Requires --delta-layout flat with "
+        "--aggregator mean, no DP and no screening; both tiers must agree "
+        "on the value",
+    )
+    p.add_argument(
         "--aggregator",
         default="mean",
         choices=["mean", "median", "trimmed_mean", "krum"],
@@ -914,6 +930,7 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
                 args, "participation_sampling", "uniform"
             ),
             telemetry=getattr(args, "telemetry", "basic"),
+            tier_fanout=getattr(args, "tier_fanout", 0),
             compute_dtype=compute_dtype,
             megabatch_clients=megabatch,
             sim=sim_config(args),

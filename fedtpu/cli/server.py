@@ -42,10 +42,27 @@ def main(argv=None) -> int:
     add_model_flags(p)
     add_fed_flags(p)
     p.add_argument("--p", default="N", help="y = run as primary")
+    p.add_argument(
+        "--role", default="auto",
+        choices=["auto", "primary", "backup", "aggregator"],
+        help="coordinator role. auto (default) keeps the legacy --p "
+        "switch: y = primary, else backup. aggregator = a mid-tier leaf "
+        "of the hierarchical topology (docs/ARCHITECTURE.md §Multi-tier): "
+        "serves SubmitPartial/SendModel on --listen for the root named by "
+        "--parent, fans StartTrain out to its --clients cohort, and "
+        "forwards one pre-weighted partial sum per round upstream "
+        "(requires --tier-fanout on BOTH tiers)",
+    )
+    p.add_argument(
+        "--parent", default=None, metavar="HOST:PORT",
+        help="aggregator role: the root's membership gate to announce "
+        "this aggregator's --listen address to (omit when the root lists "
+        "us statically in its --clients)",
+    )
     p.add_argument("--backupAddress", default="localhost")
     p.add_argument("--backupPort", default="50060")
     p.add_argument("--listen", default="localhost:50060",
-                   help="backup bind address (backup role only)")
+                   help="bind address (backup and aggregator roles)")
     p.add_argument(
         "--clients",
         default="localhost:50051,localhost:50052",
@@ -119,8 +136,48 @@ def main(argv=None) -> int:
     clients = [c.strip() for c in args.clients.split(",") if c.strip()]
     cfg = build_config(args, num_clients=len(clients))
     compress = compress_enabled(args)
+    role = args.role
+    if role == "auto":
+        role = "primary" if str(args.p).lower() == "y" else "backup"
 
-    if str(args.p).lower() == "y":
+    if role == "aggregator":
+        from fedtpu.transport.aggregator import serve_aggregator
+
+        flight = make_flight_recorder("aggregator")
+        server, agg = serve_aggregator(
+            args.listen,
+            cfg,
+            clients=clients,
+            parent=args.parent,
+            compress=compress,
+            chaos=make_chaos(args, role="aggregator"),
+        )
+        agg.flight = flight
+        obs = start_obs_server(
+            args,
+            registry=agg.telemetry.registry,
+            status_fn=agg.status_snapshot,
+            flight=flight,
+        )
+        flush = install_final_flush(args, agg.telemetry)
+        logging.info(
+            "aggregator serving on %s (cohort=%d, parent=%s)",
+            args.listen, agg.cohort_size, args.parent or "static",
+        )
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            flush()
+            agg.stop()
+            if obs is not None:
+                obs.stop()
+            server.stop(0)
+        return 0
+
+    if role == "primary":
         # Process-wide black box: armed before anything can fail, handed to
         # the server so spans/rounds/FT events feed the same ring.
         flight = make_flight_recorder("primary")

@@ -493,6 +493,61 @@ class FedConfig:
     # k*batch examples, one augment/dropout rng stream and one optimizer
     # trajectory per group.
     megabatch_clients: int = 0
+    # Hierarchical multi-tier aggregation (docs/ARCHITECTURE.md
+    # §Multi-tier): 0 (default) = flat one-tier federation. N >= 1 turns
+    # the distributed server into a two-tier ROOT whose roster entries are
+    # leaf AggregatorServer addresses, each fronting a cohort of up to N
+    # clients: the root pulls ONE pre-weighted partial sum per aggregator
+    # per round (SubmitPartial), so its per-round decode+combine work is
+    # O(aggregators), not O(clients). Root world = capacity * tier_fanout;
+    # aggregator seat j owns data-partition ranks [j*N, (j+1)*N). Requires
+    # the streaming pipeline with aggregator='mean', no DP and no
+    # screening (validate_tier_config) — partial sums destroy the
+    # per-client rows those need. Exactness: the root divides the summed
+    # partials ONCE, so the 2-tier result is bit-identical to the flat
+    # weighted mean (tests/test_aggregator.py parity pins).
+    tier_fanout: int = 0
+
+
+def validate_tier_config(fed: FedConfig, face: str) -> None:
+    """Raise on FedConfig combinations hierarchical aggregation cannot
+    honour, naming the requesting ``face`` (root or leaf — BOTH tiers run
+    this, so a misconfigured topology fails at construction on every
+    process rather than silently changing semantics mid-federation).
+
+    A partial SUM destroys per-client structure: anything that needs
+    individual client rows at the combine — robust aggregators, DP
+    clipping, Byzantine screening — is incompatible with tiering.
+    """
+    if fed.tier_fanout < 0:
+        raise ValueError(
+            f"tier_fanout must be >= 0, got {fed.tier_fanout}"
+        )
+    if fed.aggregator != "mean":
+        raise ValueError(
+            f"hierarchical aggregation ({face}) requires aggregator='mean': "
+            f"{fed.aggregator!r} needs every client row at the combine, "
+            "but tiers forward only pre-weighted sums"
+        )
+    if fed.dp_clip_norm > 0:
+        raise ValueError(
+            f"hierarchical aggregation ({face}) cannot compose with DP "
+            "clipping: per-client sensitivity bounds need individual rows "
+            "at the root"
+        )
+    if screening_enabled(fed.screen):
+        raise ValueError(
+            f"hierarchical aggregation ({face}) cannot compose with update "
+            "screening: screening statistics need individual client rows "
+            "(screen at a future leaf tier instead)"
+        )
+    if resolve_server_pipeline(fed) != "stream":
+        raise ValueError(
+            f"hierarchical aggregation ({face}) requires the streaming "
+            "pipeline: partial sums arrive as flat rows and fold through "
+            "the [rows, P] stream buffer (server_pipeline='barrier' has "
+            "no flat layout to decode them into)"
+        )
 
 
 def resolve_compute_dtype(cfg: "RoundConfig") -> str:

@@ -130,8 +130,8 @@ KINDS = WIRE_KINDS + NET_KINDS + ATTACK_KINDS + DISK_KINDS
 # The service's RPC surface plus the engine loops' pseudo-RPC, the
 # model-level attack consult, and the checkpoint store's disk consult.
 RPC_NAMES = (
-    "StartTrain", "SendModel", "HeartBeat", "CheckIfPrimaryUp",
-    "FetchModel", "Round", "Attack", "Disk", "*",
+    "StartTrain", "SendModel", "SubmitPartial", "HeartBeat",
+    "CheckIfPrimaryUp", "FetchModel", "Round", "Attack", "Disk", "*",
 )
 
 

@@ -320,6 +320,44 @@ def screen_rows(
     return keep, {"norm": norms, "cos": cos, "z": z}
 
 
+# -------------------------------------------------- hierarchical partial sums
+def partial_reduce_rows(
+    rows: jnp.ndarray, weights: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold a ``[cohort, P]`` flat buffer into ONE pre-weighted sum row.
+
+    The fan-in primitive of the hierarchical (multi-tier) topology: a leaf
+    :class:`fedtpu.transport.aggregator.AggregatorServer` reduces its
+    cohort's rows to ``(sum_i rows_i * w_i, sum_i w_i)`` and ships only
+    that pair upstream.
+
+    Exact-associativity contract (the property the 2-tier parity pins in
+    ``tests/test_aggregator.py`` hold): the partial is the UNNORMALIZED
+    weighted sum — division happens exactly once, at the root, in
+    :func:`combine_partial_rows`. Addition is associative whenever the f32
+    adds are exact, so any grouping of clients into tiers produces the
+    bit-identical mean the one-tier :func:`flat_weighted_mean` computes
+    (a mean-of-means scheme would round at every tier and cannot satisfy
+    this). Padding rule: pad coordinates are zero on entry and a weighted
+    sum of zeros is zero, so the partial row stays pad-clean.
+    """
+    w = weights.astype(rows.dtype).reshape((-1,) + (1,) * (rows.ndim - 1))
+    return jnp.sum(rows * w, axis=0), jnp.sum(weights)
+
+
+def combine_partial_rows(
+    sum_rows: jnp.ndarray, weight_sums: jnp.ndarray
+) -> jnp.ndarray:
+    """Root-side combine of the ``[aggregators, P]`` partial-sum surface:
+    ``sum(sum_rows) / max(sum(weight_sums), 1e-9)`` — the single division
+    of the whole hierarchy (see :func:`partial_reduce_rows`). With one
+    aggregator over the whole cohort this IS ``flat_weighted_mean``'s
+    program (same sum order, same epsilon guard), which is what makes the
+    single-tier degenerate case trivially bit-identical."""
+    total = jnp.maximum(jnp.sum(weight_sums), 1e-9)
+    return jnp.sum(sum_rows, axis=0) / total.astype(sum_rows.dtype)
+
+
 def int8_scales(y: jnp.ndarray, layout: FlatLayout) -> jnp.ndarray:
     """Per-coordinate int8 scale vector reproducing the per-leaf codec
     EXACTLY: scale = max|leaf| / 127 per client per leaf, computed with one

@@ -39,3 +39,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def client_sharded(mesh: Mesh, axis_name: str = "clients") -> NamedSharding:
     return NamedSharding(mesh, P(axis_name))
+
+
+def partial_row_sharding(
+    num_rows: int, axis_name: str = "clients",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> NamedSharding:
+    """Row-axis sharding for the tiered root's ``[aggregators, P]``
+    partial-sum buffer (docs/ARCHITECTURE.md §Multi-tier).
+
+    Only the leading (row) axis shards — each device then holds whole
+    partial rows and the root combine's axis-0 sum lowers to one
+    psum-style cross-device reduce, with the wide P axis left contiguous
+    for the VPU. When ``num_rows`` doesn't divide the device count the
+    mesh shrinks to the largest divisor prefix (worst case 1 device,
+    where this degrades to the ordinary single-buffer placement — the
+    CPU-backed test/bench topologies land there and are no-ops).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    while n > 1 and num_rows % n:
+        n -= 1
+    mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
+    return NamedSharding(mesh, P(axis_name))

@@ -1,0 +1,634 @@
+"""The third coordinator role: a mid-tier leaf aggregator.
+
+One :class:`~fedtpu.transport.federation.PrimaryServer` terminates every
+client RPC of a flat federation — so federation size is capped by one
+process's NIC, decode pool and HBM. The hierarchical topology
+(docs/ARCHITECTURE.md §Multi-tier) interposes a tier of
+:class:`AggregatorServer` processes between the root and the clients:
+
+- downstream, each aggregator owns a COHORT of ordinary client agents — it
+  fans StartTrain out to them with the same retry/heartbeat/membership
+  machinery the primary uses, stream-decodes their replies into a local
+  flat ``[cohort, P]`` buffer through the UNCHANGED
+  :func:`fedtpu.transport.sparse.decode_into_row` /
+  :func:`fedtpu.transport.wire.decode_into_row` paths, and partially
+  reduces the buffer to ONE pre-weighted sum row + weight sum
+  (:func:`fedtpu.ops.flat.partial_reduce_rows`);
+- upstream, it answers the root's ``SubmitPartial`` pull with that pair as
+  a single FSP1 ``partial_flat`` record, so the ROOT's per-round work is
+  O(aggregators), not O(clients) (measured: ``bench.py
+  --fanin-microbench``, artifacts/FANIN_MICROBENCH.json).
+
+Exactness: the partial is the UNNORMALIZED weighted sum — division happens
+once, at the root (:func:`fedtpu.ops.flat.combine_partial_rows`) — so the
+2-tier mean is bit-identical to the one-tier flat weighted mean whenever
+the f32 adds are exact (the associativity contract
+``tests/test_aggregator.py`` pins with dyadic-rational inputs).
+
+Fault composition (docs/FAULT_TOLERANCE.md):
+
+- *fencing*: the aggregator enforces the coordinator epoch on its parent
+  face (max-epoch tracking, STALE_COORDINATOR rejection — same rule as
+  ``ClientAgent``) and RELAYS the root's epoch downstream unchanged, so
+  clients fence against the root, not against the middle tier. A cohort
+  client that rejects the relayed epoch as stale proves the ROOT is
+  superseded — the aggregator propagates the rejection upstream by
+  aborting the SubmitPartial with the same typed status.
+- *quorum*: ``FedConfig.round_quorum`` applies PER TIER — a sub-quorum
+  cohort aborts the SubmitPartial (typed ``SUB_QUORUM`` status,
+  FAILED_PRECONDITION so the root never burns retries on it), and the
+  root masks that aggregator's row exactly like a failed client.
+- *retries*: the leaf→client budget is this process's own RetryPolicy,
+  independent of the root→aggregator budget.
+- *tracing*: the root's propagated context is adopted and re-propagated,
+  so one merged timeline spans root → aggregator → client
+  (``tools/trace_merge.py --check``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import grpc
+import jax
+import numpy as np
+
+from fedtpu import models as model_zoo
+from fedtpu.config import (
+    RoundConfig,
+    validate_retry_policy,
+    validate_tier_config,
+)
+from fedtpu.ft import HeartbeatMonitor, MembershipTable
+from fedtpu.obs import Telemetry, process_rss_bytes
+from fedtpu.obs import propagate
+from fedtpu.ops import flat as flat_ops
+from fedtpu.transport import proto, sparse, wire
+from fedtpu.transport.retry import call_with_retry, is_stale_coordinator
+from fedtpu.transport.service import (
+    TrainerServicer,
+    TrainerStub,
+    create_channel,
+    create_server,
+    probe,
+    trace_context_of,
+)
+
+log = logging.getLogger("fedtpu.aggregator")
+
+# A cohort source is the pluggable downstream of an aggregator: given
+# (round, rank_base, world) it returns the round's encoded client reply
+# payloads (FSP1/FTP1 bytes, exactly what StartTrain replies carry). The
+# default source dials the real gRPC cohort; the fan-in bench plugs a
+# SimFederation-backed source so 10k clients/round exercise the REAL
+# decode → partial-reduce → SubmitPartial path with only the local
+# training itself simulated.
+CohortSource = Callable[[int, int, int], List[bytes]]
+
+
+class AggregatorServer(TrainerServicer):
+    """Mid-tier coordinator: StartTrain fan-out below, SubmitPartial above.
+
+    ``clients`` is the cohort roster (addresses this process dials).
+    ``cohort_source`` replaces the gRPC cohort entirely (see
+    :data:`CohortSource`); ``template`` replaces the model-zoo build with
+    an explicit ``{"params", "batch_stats"}`` host pytree — both are the
+    bench/test seams and default to the real thing.
+    """
+
+    def __init__(
+        self,
+        cfg: RoundConfig,
+        clients: Sequence[str] = (),
+        parent: Optional[str] = None,
+        compress: bool = False,
+        chaos=None,
+        cohort_source: Optional[CohortSource] = None,
+        template: Optional[dict] = None,
+        identity: str = "aggregator",
+    ):
+        validate_tier_config(cfg.fed, "AggregatorServer")
+        self.cfg = cfg
+        self.parent = parent
+        self.identity = identity
+        self.telemetry = Telemetry(cfg.fed.telemetry, role="aggregator")
+        self.retry_policy = validate_retry_policy(cfg.fed.retry)
+        rp = self.retry_policy
+        self._deadlines = {
+            "StartTrain": rp.start_train_timeout_s,
+            "SendModel": rp.send_model_timeout_s,
+        }
+        self.chaos = chaos
+        self._compress = compress
+        if template is None:
+            model = model_zoo.create(cfg.model, num_classes=cfg.num_classes)
+            from fedtpu.transport.federation import _model_template
+
+            params_t, stats_t = _model_template(model, cfg)
+            template = {"params": params_t, "batch_stats": stats_t}
+        # Host zero-template ({"params","batch_stats"}) — the decode
+        # template for dense replies and the structure SendModel installs
+        # into. The flat layout (and therefore P) derives from it, so root,
+        # aggregator and clients agree on coordinates by construction.
+        self._template = template
+        self._flat_layout = flat_ops.make_layout(template)
+        self._payload_template = dict(
+            template, num_examples=np.zeros((), np.float32)
+        )
+        self._partial_reduce = jax.jit(flat_ops.partial_reduce_rows)
+        # Current global model: raw broadcast bytes (relayed verbatim
+        # downstream — no re-encode) + decoded host copy (the dense-decode
+        # base). Unset until the root's first SendModel.
+        self._global_bytes: Optional[bytes] = None
+        self._global_host: Optional[dict] = None
+        self._global_lock = threading.Lock()
+        # Parent-face fencing: max coordinator epoch seen on ANY inbound
+        # RPC (same rule as ClientAgent._fence_check).
+        self._max_epoch = -1
+        self._epoch_lock = threading.Lock()
+        self._round_seen = -1
+        self._last_partial: dict = {}
+        self.cohort_source = cohort_source
+        self.registry = MembershipTable(
+            clients,
+            metrics=self.telemetry.registry if self.telemetry.enabled
+            else None,
+        )
+        self._member_lock = threading.Lock()
+        self._stubs: Dict[str, TrainerStub] = {
+            c: self._make_stub(c) for c in clients
+        }
+        self.monitor = HeartbeatMonitor(
+            self.registry,
+            probe=self._probe_member,
+            resync=self._resync,
+            period=cfg.fed.ft_heartbeat_period_s,
+            metrics=self.telemetry.registry if self.telemetry.enabled
+            else None,
+            probe_deadline_s=rp.max_attempts
+            * (rp.probe_timeout_s + rp.backoff_max_s) + 1.0,
+        )
+        self._server: Optional[grpc.Server] = None
+        self._gate_stub: Optional[TrainerStub] = None
+
+    # ------------------------------------------------------------ plumbing
+    def _make_stub(self, client: str) -> TrainerStub:
+        return TrainerStub(
+            create_channel(
+                client, compress=self._compress,
+                trace_source=self._trace_source, chaos=self.chaos,
+            )
+        )
+
+    def _stub(self, client: str) -> Optional[TrainerStub]:
+        with self._member_lock:
+            if client not in self._stubs and self.registry.is_member(client):
+                self._stubs[client] = self._make_stub(client)
+            return self._stubs.get(client)
+
+    def _trace_source(self) -> Optional[propagate.TraceContext]:
+        tracer = self.telemetry.tracer
+        if tracer is None:
+            return None
+        return propagate.TraceContext(
+            trace_id=tracer.trace_id,
+            span_id=tracer.current_id() or 0,
+            role=self.telemetry.role or "aggregator",
+            round=self._round_seen,
+        )
+
+    def _probe_member(self, client: str) -> bool:
+        stub = self._stub(client)
+        if stub is None:
+            return False
+        return probe(
+            stub, timeout=self.retry_policy.probe_timeout_s,
+            policy=self.retry_policy, telemetry=self.telemetry,
+        ) is not None
+
+    def _resync(self, client: str) -> bool:
+        """Re-deliver the current global to a revived cohort member (the
+        resync-before-revive contract the heartbeat monitor enforces)."""
+        with self._global_lock:
+            payload = self._global_bytes
+        if payload is None:
+            return False  # nothing to resync yet; stay dead until synced
+        stub = self._stub(client)
+        if stub is None:
+            return False
+        try:
+            call_with_retry(
+                self.retry_policy, "SendModel",
+                lambda: stub.SendModel(
+                    proto.SendModelRequest(
+                        model=payload, epoch=self._max_epoch,
+                    ),
+                    timeout=self._deadlines["SendModel"],
+                ),
+                peer=client, telemetry=self.telemetry,
+            )
+            return True
+        except grpc.RpcError:
+            return False
+
+    def _fence_check(self, epoch: int, rpc: str, context) -> None:
+        """Parent-face fencing (docs/FAULT_TOLERANCE.md §Fencing): track
+        the max coordinator epoch; abort a stale sender. Aborting raises."""
+        if epoch < 0:
+            return
+        with self._epoch_lock:
+            if epoch >= self._max_epoch:
+                self._max_epoch = epoch
+                return
+            newest = self._max_epoch
+        log.warning(
+            "%s from stale coordinator epoch %d rejected (newest seen %d)",
+            rpc, epoch, newest,
+        )
+        self.telemetry.counter(
+            "fedtpu_ft_stale_rejected_total",
+            "coordinator RPCs rejected for a stale fencing epoch, by rpc",
+            labels={"rpc": rpc},
+        ).inc()
+        context.abort(
+            grpc.StatusCode.FAILED_PRECONDITION,
+            f"STALE_COORDINATOR: epoch {epoch} < {newest}",
+        )
+
+    # ----------------------------------------------------- inbound surface
+    def SendModel(
+        self, request: proto.SendModelRequest, context
+    ) -> proto.SendModelReply:
+        """Install the root's global and relay it to the cohort. The relay
+        re-ships the root's bytes verbatim (no re-encode) with the root's
+        epoch, so downstream fencing is against the root's lineage."""
+        self._fence_check(request.epoch, "SendModel", context)
+        ctx = trace_context_of(context)
+        propagate.adopt(self.telemetry.tracer, ctx)
+        with self.telemetry.span("install_global",
+                                 **propagate.span_args(ctx)):
+            tree = wire.decode(request.model, self._template)
+            with self._global_lock:
+                self._global_bytes = request.model
+                self._global_host = {
+                    k: tree[k] for k in ("params", "batch_stats")
+                }
+        self.telemetry.counter(
+            "fedtpu_rpc_bytes_down_total",
+            "payload bytes shipped/received on the downstream face",
+        ).inc(len(request.model))
+        failed = self._relay_model(request.model, request.epoch)
+        return proto.SendModelReply(
+            reply=f"relayed:{self.cohort_size - failed}/"
+                  f"{self.cohort_size}".encode()
+        )
+
+    def _relay_model(self, payload: bytes, epoch: int) -> int:
+        """Best-effort downstream broadcast; returns the failure count.
+        Failed members are marked for the heartbeat/resync machinery —
+        exactly the primary's broadcast semantics, one tier down."""
+        if self.cohort_source is not None:
+            return 0  # simulated cohorts hold no installable state
+        failures = [0]
+
+        def send_one(client: str) -> None:
+            stub = self._stub(client)
+            if stub is None:
+                return
+            try:
+                with self.telemetry.span("broadcast", client=client):
+                    call_with_retry(
+                        self.retry_policy, "SendModel",
+                        lambda: stub.SendModel(
+                            proto.SendModelRequest(model=payload, epoch=epoch),
+                            timeout=self._deadlines["SendModel"],
+                        ),
+                        peer=client, telemetry=self.telemetry,
+                    )
+            except grpc.RpcError:
+                failures[0] += 1
+                self.telemetry.counter(
+                    "fedtpu_rpc_failures_total", "RpcErrors by failing RPC",
+                    labels={"rpc": "SendModel"},
+                ).inc()
+                self.registry.mark_failed(client)
+
+        threads = [
+            threading.Thread(target=send_one, args=(c,), daemon=True)
+            for c in self.registry.active_clients()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return failures[0]
+
+    def HeartBeat(self, request: proto.Request, context) -> proto.HeartBeatResponse:
+        return proto.HeartBeatResponse(status=1)
+
+    def SubmitPartial(
+        self, request: proto.SubmitPartialRequest, context
+    ) -> proto.SubmitPartialReply:
+        """One pulled partial reduce: fan StartTrain out to the cohort,
+        stream-decode replies into the local ``[cohort, P]`` buffer, fold
+        it to one pre-weighted sum row, reply with the FSP1 record."""
+        self._fence_check(request.epoch, "SubmitPartial", context)
+        ctx = trace_context_of(context)
+        propagate.adopt(self.telemetry.tracer, ctx)
+        self._round_seen = request.round
+        tel = self.telemetry
+        t_start = time.monotonic()
+        with tel.span("submit_partial", round=request.round,
+                      rank_base=request.rank_base,
+                      **propagate.span_args(ctx)) as pspan:
+            reply = self._submit_partial_impl(request, context, pspan)
+        tel.histogram(
+            "fedtpu_round_phase_seconds",
+            "per-round phase durations by phase",
+            labels={"phase": "submit_partial"},
+        ).observe(time.monotonic() - t_start)
+        return reply
+
+    def _submit_partial_impl(self, request, context, pspan):
+        tel = self.telemetry
+        layout = self._flat_layout
+        cfg = self.cfg
+        if self.cohort_source is not None:
+            payloads = self.cohort_source(
+                request.round, request.rank_base, request.world
+            )
+            launch = [f"sim:{i}" for i in range(len(payloads))]
+            payload_of = dict(zip(launch, payloads))
+            rank_of = {c: request.rank_base + i
+                       for i, c in enumerate(launch)}
+        else:
+            with self._global_lock:
+                synced = self._global_host is not None
+            if not synced and cfg.fed.compression == "none":
+                # Dense replies need the global as a delta base; without
+                # one this tier cannot produce a partial. Typed + fatal:
+                # the root masks the row and its resync path delivers the
+                # model before the next pull.
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    "UNSYNCED_AGGREGATOR: no global model installed yet",
+                )
+            payload_of = None
+            launch = self.registry.active_clients()
+            seats = self.registry.seat_map()
+            rank_of = {c: request.rank_base + seats[c] for c in launch}
+        members_now = max(self.registry.size, 1)
+        if not launch:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"SUB_QUORUM: 0/{members_now} cohort members alive",
+            )
+
+        rows = np.zeros((len(launch), layout.padded), np.float32)
+        row_of = {c: i for i, c in enumerate(launch)}
+        tel.gauge(
+            "fedtpu_buffer_bytes",
+            "host+device bytes of the round's flat delta buffers, by tier",
+            labels={"tier": "leaf"},
+        ).set(rows.nbytes)
+        tel.gauge(
+            "fedtpu_partial_rows_buffered",
+            "cohort rows currently buffered toward this tier's partial "
+            "reduce",
+        ).set(len(launch))
+
+        results: Dict[str, float] = {}
+        stale: List[str] = []
+        lock = threading.Lock()
+
+        def decode_one(client: str, data: bytes) -> float:
+            row = rows[row_of[client]]
+            with tel.span("decode", client=client):
+                if sparse.is_sparse_payload(data):
+                    extra = sparse.decode_into_row(data, layout.sizes, row)
+                else:
+                    with self._global_lock:
+                        base = self._global_host
+                    extra = wire.decode_into_row(
+                        data, self._payload_template, base, row
+                    )
+            tel.counter(
+                "fedtpu_rpc_bytes_up_total",
+                "payload bytes received on the upstream-bound face",
+            ).inc(len(data))
+            return float(extra["num_examples"])
+
+        def train_one(client: str) -> None:
+            def attempt() -> float:
+                reply = self._stub(client).StartTrain(
+                    proto.TrainRequest(
+                        rank=rank_of[client], world=request.world,
+                        round=request.round, epoch=request.epoch,
+                    ),
+                    timeout=self._deadlines["StartTrain"],
+                )
+                return decode_one(client, reply.message)
+
+            try:
+                with tel.span("client_rpc", parent=pspan.id, client=client):
+                    n = call_with_retry(
+                        self.retry_policy, "StartTrain", attempt,
+                        peer=client, telemetry=tel,
+                    )
+                with lock:
+                    results[client] = n
+            except (grpc.RpcError, wire.WireError) as e:
+                if is_stale_coordinator(e):
+                    # A cohort client outranks our caller's epoch: the
+                    # ROOT is superseded. Record for upstream propagation;
+                    # never mark the client failed (it is the healthy one).
+                    with lock:
+                        stale.append(e.details() or "STALE_COORDINATOR")
+                    return
+                log.warning("cohort member %s failed StartTrain: %s",
+                            client, e)
+                tel.counter(
+                    "fedtpu_rpc_failures_total", "RpcErrors by failing RPC",
+                    labels={"rpc": "StartTrain"},
+                ).inc()
+                self.registry.mark_failed(client)
+
+        t0 = time.monotonic()
+        with tel.span("collect", parent=pspan.id, cohort=len(launch)):
+            if payload_of is not None:
+                # Simulated cohort: the payloads ARE the replies; decode
+                # them through the identical streaming path.
+                for client in launch:
+                    try:
+                        results[client] = decode_one(
+                            client, payload_of[client]
+                        )
+                    except wire.WireError as e:
+                        log.warning("sim payload for %s rejected: %s",
+                                    client, e)
+            else:
+                threads = [
+                    threading.Thread(target=train_one, args=(c,), daemon=True)
+                    for c in launch
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        t_collect = time.monotonic() - t0
+        tel.gauge("fedtpu_partial_rows_buffered", "").set(0)
+
+        if stale:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, stale[0])
+        quorum = cfg.fed.round_quorum
+        needed = (
+            max(1, int(np.ceil(quorum * members_now))) if quorum > 0 else 0
+        )
+        if len(results) < needed:
+            tel.counter(
+                "fedtpu_round_aborts_total",
+                "rounds aborted below quorum, by surface",
+                labels={"surface": "aggregator"},
+            ).inc()
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"SUB_QUORUM: {len(results)}/{members_now} cohort replies "
+                f"< quorum {quorum}",
+            )
+        if not results:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"SUB_QUORUM: 0/{members_now} cohort replies",
+            )
+
+        order = [c for c in launch if c in results]
+        keep = rows[[row_of[c] for c in order]]
+        weights = np.asarray(
+            [results[c] for c in order] if cfg.fed.weighted
+            else [1.0] * len(order),
+            np.float32,
+        )
+        t1 = time.monotonic()
+        with tel.span("partial_reduce", parent=pspan.id, rows=len(order)):
+            sum_row, weight_sum = self._partial_reduce(keep, weights)
+            sum_row = np.asarray(jax.block_until_ready(sum_row))
+            weight_sum = float(weight_sum)
+        tel.histogram(
+            "fedtpu_round_phase_seconds", "",
+            labels={"phase": "partial_reduce"},
+        ).observe(time.monotonic() - t1)
+        record = sparse.encode_partial_flat(
+            sum_row[: layout.total], layout.sizes,
+            extra={
+                "weight_sum": np.float32(weight_sum),
+                "clients": np.int64(len(order)),
+                "t_leaf_s": np.float32(time.monotonic() - t0),
+            },
+        )
+        self._last_partial = {
+            "round": request.round,
+            "clients": len(order),
+            "cohort": len(launch),
+            "weight_sum": weight_sum,
+            "t_collect_s": t_collect,
+            "buffer_bytes": int(rows.nbytes),
+        }
+        tel.counter("fedtpu_rounds_completed_total",
+                    "partial reduces completed by this tier").inc()
+        return proto.SubmitPartialReply(
+            record=record, clients=len(order)
+        )
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def cohort_size(self) -> int:
+        return self.registry.size
+
+    def status_snapshot(self) -> dict:
+        """``/statusz`` feed for an aggregator process."""
+        with self._global_lock:
+            synced = self._global_host is not None
+        return {
+            "role": self.telemetry.role or "aggregator",
+            "pid": os.getpid(),
+            "tier": "leaf",
+            "parent": self.parent,
+            "round": self._round_seen,
+            "synced": synced,
+            "clients": {
+                "active": len(self.registry.active_clients()),
+                "dead": len(self.registry.dead_clients()),
+                "total": self.registry.size,
+            },
+            "mem": {
+                "rss_bytes": process_rss_bytes(),
+                "buffer_bytes": int(
+                    self._last_partial.get("buffer_bytes", 0)
+                ),
+                "partial_rows_buffered": (
+                    int(
+                        self.telemetry.registry.gauge(
+                            "fedtpu_partial_rows_buffered", ""
+                        ).value
+                    )
+                    if self.telemetry.enabled else 0
+                ),
+                "tier": "leaf",
+            },
+            "last_partial": dict(self._last_partial),
+            "fencing": {"epoch_seen": self._max_epoch},
+        }
+
+    def start(self, address: str) -> grpc.Server:
+        """Serve the upstream face on ``address`` and start cohort
+        heartbeats; then announce this address to the parent's membership
+        gate when ``parent`` is set (the aggregator IS a member of the
+        root's roster — same join flow as an elastic client)."""
+        self._server = create_server(
+            address, self, compress=self._compress, chaos=self.chaos
+        )
+        self._server.start()
+        if self.registry.size and self.cohort_source is None:
+            self.monitor.start()
+        if self.parent:
+            from fedtpu.transport.service import announce_join
+
+            self._gate_stub = announce_join(self.parent, address)
+            if self._gate_stub is None:
+                log.warning("parent gate %s never admitted us", self.parent)
+        return self._server
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.monitor.stop()
+        if self._gate_stub is not None and self.identity:
+            from fedtpu.transport.service import announce_leave
+
+            announce_leave(self._gate_stub, self.identity)
+        if self._server is not None:
+            self._server.stop(grace)
+
+
+def serve_aggregator(
+    address: str,
+    cfg: RoundConfig,
+    clients: Sequence[str] = (),
+    parent: Optional[str] = None,
+    compress: bool = False,
+    chaos=None,
+    cohort_source: Optional[CohortSource] = None,
+    template: Optional[dict] = None,
+):
+    """Build + start an aggregator on ``address``; returns
+    (server, aggregator). The bind address doubles as the process's
+    trace/flight identity, mirroring :func:`serve_client`."""
+    agg = AggregatorServer(
+        cfg, clients=clients, parent=parent, compress=compress, chaos=chaos,
+        cohort_source=cohort_source, template=template, identity=address,
+    )
+    agg.telemetry.role = f"aggregator:{address}"
+    server = agg.start(address)
+    return server, agg

@@ -45,6 +45,7 @@ from fedtpu.config import (
     screening_enabled,
     validate_retry_policy,
     validate_screen_config,
+    validate_tier_config,
 )
 from fedtpu.core.client import make_eval_fn, make_local_update
 from fedtpu.core import optim
@@ -881,6 +882,23 @@ class PrimaryServer:
                 donate_argnums=0,
             )
             self._finalize_stream = jax.jit(self._finalize_stream_impl)
+        # Hierarchical multi-tier aggregation (docs/ARCHITECTURE.md
+        # §Multi-tier): tier_fanout > 0 flips this server into the ROOT of
+        # a two-tier topology — the roster holds leaf AggregatorServer
+        # addresses, each round's fan-out is one SubmitPartial pull per
+        # aggregator, the stream buffer holds [aggregators, P] pre-weighted
+        # partial SUMS (row-axis sharded across local devices), and the
+        # finalize divides ONCE over the summed weights
+        # (_finalize_partial_impl — the exact-associativity contract that
+        # keeps the 2-tier mean bit-identical to the flat one).
+        self.tier_fanout = cfg.fed.tier_fanout
+        if self.tier_fanout:
+            validate_tier_config(cfg.fed, "PrimaryServer")
+            # The pull shares the training-RPC deadline: a SubmitPartial
+            # blocks on the leaf's whole cohort collect, i.e. the same
+            # critical path StartTrain bounds one tier down.
+            self._deadlines["SubmitPartial"] = self._deadlines["StartTrain"]
+            self._finalize_partial = jax.jit(self._finalize_partial_impl)
         # Fused update screening (ScreenConfig, docs/FAULT_TOLERANCE.md):
         # one jitted stats pass over the round's [participants, P] rows —
         # the SAME device-resident buffer the stream finalize reads, so the
@@ -1013,6 +1031,33 @@ class PrimaryServer:
         from fedtpu.ops import flat as flat_ops
 
         mean_row = flat_weighted_mean(rows, weights)
+        deltas = flat_ops.unpack(self._flat_layout, mean_row)
+        new_params, new_opt = server_opt_lib.apply(
+            self._server_opt, global_tree["params"], deltas["params"], opt_state
+        )
+        new_stats = jax.tree.map(
+            lambda g, d: g + d, global_tree["batch_stats"], deltas["batch_stats"]
+        )
+        return {"params": new_params, "batch_stats": new_stats}, new_opt
+
+    def _finalize_partial_impl(
+        self, global_tree, sum_rows, weight_sums, opt_state
+    ):
+        """Tier-mode finalize: the stream buffer's rows are the leaf tiers'
+        PRE-WEIGHTED sums, so the combine is sum-of-sums divided ONCE by
+        the global weight total (:func:`fedtpu.ops.flat.combine_partial_rows`)
+        — NOT :func:`fedtpu.core.round.flat_weighted_mean`, which would
+        re-multiply each partial by its own weight sum and silently square
+        the weighting. The single division is the exact-associativity
+        contract: for inputs whose f32 adds are exact, the 2-tier result is
+        bit-identical to the flat one-tier weighted mean
+        (tests/test_aggregator.py parity pins). Everything downstream
+        (unpack, server-optimizer step, BN add) is the flat path's code.
+        """
+        from fedtpu.core import server_opt as server_opt_lib
+        from fedtpu.ops import flat as flat_ops
+
+        mean_row = flat_ops.combine_partial_rows(sum_rows, weight_sums)
         deltas = flat_ops.unpack(self._flat_layout, mean_row)
         new_params, new_opt = server_opt_lib.apply(
             self._server_opt, global_tree["params"], deltas["params"], opt_state
@@ -1660,6 +1705,18 @@ class PrimaryServer:
                     int(self.history[-1].get("buffer_bytes", 0))
                     if self.history else 0
                 ),
+                # Tier accounting (docs/ARCHITECTURE.md §Multi-tier):
+                # which tier's buffer this is, and the partial rows held
+                # toward an in-flight root combine (0 between rounds).
+                "tier": "root" if self.tier_fanout else "flat",
+                "partial_rows_buffered": (
+                    int(
+                        self.telemetry.registry.gauge(
+                            "fedtpu_partial_rows_buffered", ""
+                        ).value
+                    )
+                    if self.tier_fanout and self.telemetry.enabled else 0
+                ),
             },
             stragglers_in_flight=sorted(
                 c for c, t in self._inflight.items() if t.is_alive()
@@ -1727,8 +1784,19 @@ class PrimaryServer:
             tel.gauge(
                 "fedtpu_buffer_bytes",
                 "flat collect-buffer bytes held by the last round "
-                "(host rows + device twin; 0 on the barrier path)",
+                "(host rows + device twin; 0 on the barrier path), by "
+                "tier: 'flat' = one-tier federation, 'root' = the tiered "
+                "root's [aggregators, P] surface, 'leaf' = a sub-"
+                "aggregator's [cohort, P] buffer",
+                labels={"tier": "root" if self.tier_fanout else "flat"},
             ).set(rec.get("buffer_bytes", 0))
+            if self.tier_fanout:
+                # The round's partial rows are combined and released.
+                tel.gauge(
+                    "fedtpu_partial_rows_buffered",
+                    "partial-sum rows (one per sub-aggregator) buffered "
+                    "toward this round's root combine",
+                ).set(0)
         if rec.get("aborted"):
             # Sub-quorum abort: the abort already logged its own flight
             # event and counter inside _round_body; it is NOT a completed
@@ -1821,6 +1889,15 @@ class PrimaryServer:
         # every other client's shard stays put — and grows only when the
         # roster genuinely outgrows it.
         world = self.registry.capacity()
+        tiered = self.tier_fanout > 0
+        if tiered:
+            # Tier mode: world spans the CLIENT data partition, not the
+            # aggregator roster — aggregator seat j relays ranks
+            # [j*fanout, (j+1)*fanout) to its cohort, so the tiers tile the
+            # dataset without coordination and a flat federation of the
+            # same world trains identical shards (the parity pins rely on
+            # this).
+            world = world * self.tier_fanout
         # Host copies of the global model are only needed for dense replies /
         # sparse templates; build them lazily (in topk steady state the full
         # device->host transfer would otherwise run every round for nothing).
@@ -1863,6 +1940,10 @@ class PrimaryServer:
         # telemetry mode).
         bytes_up = Counter()  # client -> server payload bytes this round
         bytes_down = Counter()  # only successful sends count
+        # Tier mode: total leaf clients behind this round's partials (each
+        # SubmitPartialReply reports its cohort's contributor count) — the
+        # round record's participants stay the DIRECT peers (aggregators).
+        clients_in = Counter()
         stream = self.server_pipeline == "stream"
         # Per-round phase timing (satellite of the streaming pipeline):
         # decode / H2D are summed across clients; collect and the
@@ -1896,14 +1977,31 @@ class PrimaryServer:
                 # — reject-and-retry, never "silently lose the client's
                 # round" (the pre-policy behavior: the worker thread died
                 # with the exception and the reply just vanished).
-                reply = stub.StartTrain(
-                    proto.TrainRequest(
-                        rank=rank, world=world, round=lineage_round,
-                        epoch=self._coord_epoch,
-                    ),
-                    timeout=self._deadlines["StartTrain"],
-                )
-                data = reply.message
+                if tiered:
+                    # One pulled partial reduce: the aggregator fans
+                    # StartTrain out to its cohort, folds the replies to a
+                    # pre-weighted sum and answers with ONE FSP1
+                    # partial_flat record — the root's per-peer work below
+                    # is a single straight-copy decode, whatever the
+                    # cohort size (bench.py --fanin-microbench).
+                    reply = stub.SubmitPartial(
+                        proto.SubmitPartialRequest(
+                            rank_base=rank * self.tier_fanout, world=world,
+                            round=lineage_round, epoch=self._coord_epoch,
+                        ),
+                        timeout=self._deadlines["SubmitPartial"],
+                    )
+                    data = reply.record
+                    clients_in.inc(reply.clients)
+                else:
+                    reply = stub.StartTrain(
+                        proto.TrainRequest(
+                            rank=rank, world=world, round=lineage_round,
+                            epoch=self._coord_epoch,
+                        ),
+                        timeout=self._deadlines["StartTrain"],
+                    )
+                    data = reply.message
                 if stream:
                     # Decode straight into this client's row — no
                     # per-leaf template trees, no later leaf-by-leaf
@@ -1946,7 +2044,14 @@ class PrimaryServer:
                     t2 = time.monotonic()
                     decode_s.inc(t1 - t0)
                     h2d_s.inc(t2 - t1)
-                    out = (row_of[client], float(extra["num_examples"]))
+                    # Tier mode: the combine weight is the partial's summed
+                    # example weight (the leaf already applied cfg.fed
+                    # weighting per client), not a per-client count.
+                    out = (
+                        row_of[client],
+                        float(extra["weight_sum" if tiered
+                                    else "num_examples"]),
+                    )
                 elif sparse.is_sparse_payload(data):
                     t0 = time.monotonic()
                     with tel.span("decode", client=client):
@@ -1976,11 +2081,13 @@ class PrimaryServer:
                 bytes_up.inc(len(data))
                 return out
 
+            rpc_name = "SubmitPartial" if tiered else "StartTrain"
             try:
                 t_rpc = time.monotonic()
-                with tel.span("client_rpc", parent=rspan.id, client=client):
+                with tel.span("submit_partial" if tiered else "client_rpc",
+                              parent=rspan.id, client=client):
                     results[client] = call_with_retry(
-                        self.retry_policy, "StartTrain", attempt,
+                        self.retry_policy, rpc_name, attempt,
                         peer=client, telemetry=tel,
                         rand=self._retry_rand,
                     )
@@ -1992,28 +2099,37 @@ class PrimaryServer:
                 ).observe(latencies[client])
             except (grpc.RpcError, wire.WireError) as e:
                 if is_stale_coordinator(e):
-                    # The client has seen a higher coordinator epoch: WE
-                    # are the stale side of a healed partition. The client
-                    # is healthy — never mark it failed; flip the fence
-                    # and let the round loop void this round and re-base.
-                    self._handle_stale("StartTrain", client, e)
+                    # The peer has seen a higher coordinator epoch: WE are
+                    # the stale side of a healed partition. (In tier mode
+                    # the aggregator RELAYS a cohort client's rejection
+                    # upstream on the same typed status, so the evidence
+                    # reaches here whichever tier observed the newer
+                    # lineage.) The peer is healthy — never mark it
+                    # failed; flip the fence and let the round loop void
+                    # this round and re-base.
+                    self._handle_stale(rpc_name, client, e)
                     return
                 # Only a FATAL status or an exhausted retry budget lands
-                # here — the designed path to mark_failed.
+                # here — the designed path to mark_failed. In tier mode
+                # that includes an aggregator's typed SUB_QUORUM /
+                # UNSYNCED_AGGREGATOR aborts (FAILED_PRECONDITION, never
+                # retried): the whole cohort becomes ONE masked row and
+                # the heartbeat/resync machinery revives the aggregator.
                 if isinstance(e, grpc.RpcError):
                     log.warning(
-                        "client %s failed during StartTrain: %s %s",
-                        client, e.code(), e.details(),
+                        "%s %s failed during %s: %s %s",
+                        "aggregator" if tiered else "client", client,
+                        rpc_name, e.code(), e.details(),
                     )
                 else:
                     log.warning(
-                        "client %s StartTrain reply still corrupt after "
-                        "retries: %s", client, e,
+                        "%s %s reply still corrupt after retries: %s",
+                        client, rpc_name, e,
                     )
                 tel.counter(
                     "fedtpu_rpc_failures_total",
                     "RpcErrors by failing RPC",
-                    labels={"rpc": "StartTrain"},
+                    labels={"rpc": rpc_name},
                 ).inc()
                 self.registry.mark_failed(client)
 
@@ -2072,7 +2188,25 @@ class PrimaryServer:
             row_of.update({c: i for i, c in enumerate(launch)})
             padded = self._flat_layout.padded
             host_rows.append(np.zeros((len(launch), padded), np.float32))
-            dev_buf.append(jnp.zeros((len(launch), padded), jnp.float32))
+            buf = jnp.zeros((len(launch), padded), jnp.float32)
+            if tiered:
+                # Tier mode: the combine surface is [aggregators, P] —
+                # shard it on the ROW axis so each local device owns whole
+                # partial rows and the finalize's axis-0 sum becomes one
+                # cross-device reduce (no-op on a single device, where the
+                # helper degrades to ordinary placement).
+                from fedtpu.parallel.mesh import partial_row_sharding
+
+                buf = jax.device_put(
+                    buf, partial_row_sharding(len(launch))
+                )
+            dev_buf.append(buf)
+            if tiered and tel.enabled:
+                tel.gauge(
+                    "fedtpu_partial_rows_buffered",
+                    "partial-sum rows (one per sub-aggregator) buffered "
+                    "toward this round's root combine",
+                ).set(len(launch))
         t_launch = time.monotonic()
         with tel.span("collect", launched=len(launch)):
             threads = {
@@ -2303,7 +2437,12 @@ class PrimaryServer:
 
         if order:
             with tel.span("aggregate", participants=len(order)):
-                if cfg.fed.weighted:
+                if cfg.fed.weighted or tiered:
+                    # Tier mode always takes this arm: completed[c][1] is
+                    # the partial's WEIGHT SUM — the leaf already applied
+                    # the configured weighting (example counts or 1.0 per
+                    # client), so an unweighted federation's partials carry
+                    # the cohort's contributor count here.
                     weights = jnp.asarray(
                         [completed[c][1] for c in order], jnp.float32
                     )
@@ -2312,10 +2451,16 @@ class PrimaryServer:
                 if stream:
                     # The rows are already device-resident (shipped on
                     # arrival) — the only post-barrier work is ONE fused
-                    # finalize over the surviving rows.
+                    # finalize over the surviving rows. Tier mode's rows
+                    # are pre-weighted partial SUMS and take the
+                    # single-division combine (_finalize_partial_impl).
                     rows = srows
+                    finalize = (
+                        self._finalize_partial if tiered
+                        else self._finalize_stream
+                    )
                     new_global, self._server_opt_state = (
-                        self._finalize_stream(
+                        finalize(
                             {"params": self.params,
                              "batch_stats": self.batch_stats},
                             rows,
@@ -2494,6 +2639,13 @@ class PrimaryServer:
             "t_post_barrier_s": round(t_done - t_barrier, 6),
             "t_round_s": round(t_done - t_launch, 6),
         }
+        if tiered:
+            # Topology accounting: participants above counts DIRECT peers
+            # (aggregators); clients_aggregated is the leaf-client total
+            # behind this round's partials — the fan-in bench's
+            # work-vs-clients gate reads both.
+            rec["tier_fanout"] = self.tier_fanout
+            rec["clients_aggregated"] = int(clients_in.value)
         from fedtpu.obs.profile import latency_summary
 
         lat = latency_summary(

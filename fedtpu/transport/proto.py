@@ -320,6 +320,67 @@ class JoinReply:
         )
 
 
+# Hierarchical-aggregation extension (docs/ARCHITECTURE.md §Multi-tier):
+# the ROOT coordinator pulls one partial reduce per round from each leaf
+# AggregatorServer over SubmitPartial. Both messages are additive — new
+# method name, new field numbers, proto3 omit-zero throughout — so a
+# legacy peer that never speaks SubmitPartial sees zero new wire bytes on
+# the original RPCs, and an unset message encodes to b"" (pinned in
+# tests/test_transport.py).
+@dataclasses.dataclass
+class SubmitPartialRequest:
+    # First cohort rank this aggregator hands out: cohort member i trains
+    # shard ``rank_base + i`` of the root-wide ``world``-way partition, so
+    # tiers tile the data partition without coordination.
+    rank_base: int = 0
+    world: int = 0
+    # Coordinator lineage round / fencing epoch, +1 omit-zero encoded
+    # exactly like TrainRequest fields 3/4 (-1 reads back as "absent").
+    round: int = -1
+    epoch: int = -1
+
+    def encode(self) -> bytes:
+        return _encode_fields([
+            (1, _VARINT, self.rank_base),
+            (2, _VARINT, self.world),
+            (3, _VARINT, self.round + 1),
+            (4, _VARINT, self.epoch + 1),
+        ])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SubmitPartialRequest":
+        f = _decode_fields(data)
+        return cls(
+            rank_base=_int32(f.get(1, 0)),
+            world=_int32(f.get(2, 0)),
+            round=_int32(f.get(3, 0)) - 1,
+            epoch=_int32(f.get(4, 0)) - 1,
+        )
+
+
+@dataclasses.dataclass
+class SubmitPartialReply:
+    # One FSP1 ``partial_flat`` record (fedtpu.transport.sparse): the
+    # cohort's pre-weighted sum row + weight sum, framed/CRC'd like every
+    # other delta payload.
+    record: bytes = b""
+    # How many cohort replies folded into the record (telemetry/records
+    # only — the combine weight travels INSIDE the record, where it is
+    # covered by the frame CRC).
+    clients: int = 0
+
+    def encode(self) -> bytes:
+        return _encode_fields([
+            (1, _LEN, self.record),
+            (2, _VARINT, self.clients),
+        ])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SubmitPartialReply":
+        f = _decode_fields(data)
+        return cls(record=f.get(1, b""), clients=_int32(f.get(2, 0)))
+
+
 @dataclasses.dataclass
 class LeaveRequest:
     address: bytes = b""

@@ -51,6 +51,13 @@ _METHODS = {
     # primary after a failover, so joiners keep working mid-outage).
     "Join": (proto.JoinRequest, proto.JoinReply),
     "Leave": (proto.LeaveRequest, proto.LeaveReply),
+    # Hierarchical aggregation (docs/ARCHITECTURE.md §Multi-tier): the root
+    # PULLS one partial reduce per round from each leaf AggregatorServer —
+    # same dial-out direction as StartTrain, so retry/quorum/fencing/trace
+    # machinery applies unchanged. Additive method: legacy peers answer it
+    # UNIMPLEMENTED (a fatal, non-retried code) and never see new bytes on
+    # the original RPCs.
+    "SubmitPartial": (proto.SubmitPartialRequest, proto.SubmitPartialReply),
 }
 
 
@@ -103,6 +110,12 @@ class TrainerServicer:
         raise NotImplementedError
 
     def Leave(self, request: proto.LeaveRequest, context) -> proto.LeaveReply:
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        raise NotImplementedError
+
+    def SubmitPartial(
+        self, request: proto.SubmitPartialRequest, context
+    ) -> proto.SubmitPartialReply:
         context.set_code(grpc.StatusCode.UNIMPLEMENTED)
         raise NotImplementedError
 

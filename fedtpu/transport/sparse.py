@@ -23,8 +23,14 @@ int8 code) block over the concatenated flat vector, plus a ``sizes`` offsets
 table for validation. Top-k selection is then GLOBAL across the model (one
 ``kth_magnitude`` over the concatenation); int8 keeps per-leaf scales (a
 ``[num_leaves]`` f32 array), matching the engine's flat codec bit-for-bit.
-The same ``FSP1`` frame carries all four kinds; :func:`decode` dispatches on
+The same ``FSP1`` frame carries all the kinds; :func:`decode` dispatches on
 ``kind``, so receivers need no code change to accept flat senders.
+
+Hierarchical fan-in adds a fifth kind, ``partial_flat``
+(:func:`encode_partial_flat`): ONE dense f32 row carrying a leaf
+aggregator's pre-weighted SUM of its cohort's flat delta rows plus the
+summed combine weight (``extra['weight_sum']``) — the payload of the
+``SubmitPartial`` RPC (docs/FLAT_DELTA.md §FSP1 record kinds).
 """
 
 from __future__ import annotations
@@ -296,6 +302,39 @@ def encode_int8_flat(
     return payload, residual_tree
 
 
+def encode_partial_flat(
+    row: np.ndarray, sizes, extra: Optional[dict] = None
+) -> bytes:
+    """Hierarchical-aggregation wire record (kind ``partial_flat``): ONE
+    dense f32 row — a cohort's PRE-WEIGHTED sum of flat delta rows
+    (:func:`fedtpu.ops.flat.partial_reduce_rows`) — plus the per-leaf
+    ``sizes`` table for validation. A sum of many clients' updates has no
+    exploitable sparsity, so the record is dense by design; what the
+    hierarchy saves is FAN-IN (the root decodes one record per aggregator,
+    not one per client), not per-record bytes.
+
+    ``extra`` MUST carry ``weight_sum`` (the cohort's summed combine
+    weights — the root's combine weight for this row) and conventionally
+    carries ``clients`` / ``t_leaf_s`` for records and the fan-in bench.
+    ``row`` is the UNPADDED ``[total]`` prefix (pad coordinates of a
+    pad-clean buffer are zero under a weighted sum, so they never travel).
+    """
+    sizes = [int(s) for s in sizes]
+    row = np.ascontiguousarray(row, np.float32)
+    if row.ndim != 1 or row.size != sum(sizes):
+        raise ValueError(
+            f"partial row has {row.shape} coordinates, sizes table sums to "
+            f"{sum(sizes)}"
+        )
+    body = {
+        "kind": "partial_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "row": row,
+        "extra": extra or {},
+    }
+    return _frame(serialization.msgpack_serialize(body))
+
+
 def _decode_flat(body: dict, leaves, treedef) -> Pytree:
     """Reconstruct a dense delta pytree from a flat record body."""
     sizes = np.asarray(body["sizes"], np.int64)
@@ -307,7 +346,11 @@ def _decode_flat(body: dict, leaves, treedef) -> Pytree:
         if int(n) != np.size(leaf):
             raise WireError("flat leaf size mismatch with template")
     total = int(sizes.sum())
-    if body["kind"] == "topk_flat":
+    if body["kind"] == "partial_flat":
+        dense = np.asarray(body["row"], np.float32)
+        if dense.size != total:
+            raise WireError("partial_flat row size mismatch with template")
+    elif body["kind"] == "topk_flat":
         idx = np.ascontiguousarray(body["idx"], np.int32)
         # Untrusted wire data: the native scatter writes unchecked.
         if idx.size and (idx.min() < 0 or idx.max() >= total):
@@ -368,7 +411,7 @@ def decode_into_row(
             f"for {total} coordinates"
         )
     kind = body.get("kind")
-    if kind in ("topk_flat", "int8_flat"):
+    if kind in ("topk_flat", "int8_flat", "partial_flat"):
         wire_sizes = np.asarray(body["sizes"], np.int64)
         if len(wire_sizes) != len(sizes):
             raise WireError(
@@ -378,7 +421,16 @@ def decode_into_row(
         for n, m in zip(wire_sizes, sizes):
             if int(n) != m:
                 raise WireError("flat leaf size mismatch with layout")
-        if kind == "topk_flat":
+        if kind == "partial_flat":
+            # Hierarchical partial sum: a dense f32 row lands verbatim —
+            # the straight-copy degenerate case of the streaming decode
+            # (the root's per-aggregator cost is ONE memcpy + validation,
+            # the O(aggregators) claim the fan-in bench measures).
+            row = np.asarray(body["row"], np.float32)
+            if row.size != total:
+                raise WireError("partial_flat row size mismatch with layout")
+            out[:total] = row
+        elif kind == "topk_flat":
             idx = np.ascontiguousarray(body["idx"], np.int32)
             # Untrusted wire data: the scatter below writes unchecked.
             if idx.size and (idx.min() < 0 or idx.max() >= total):
@@ -430,7 +482,7 @@ def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
     (deltas, extra)."""
     body = serialization.msgpack_restore(_unframe(data))
     leaves, treedef = jax.tree_util.tree_flatten(like)
-    if body.get("kind") in ("topk_flat", "int8_flat"):
+    if body.get("kind") in ("topk_flat", "int8_flat", "partial_flat"):
         return (
             _decode_flat(body, leaves, treedef),
             dict(body.get("extra", {})),

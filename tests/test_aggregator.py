@@ -1,0 +1,475 @@
+"""Hierarchical multi-tier aggregation (PR 14): the AggregatorServer role.
+
+The exactness spine: 2-tier parity pins for every flat codec (dense /
+int8 / top-k) with DYADIC-RATIONAL inputs, where the partial-reduce
+associativity contract makes the tiered mean BYTE-FOR-BYTE identical to
+the one-tier :func:`fedtpu.core.round.flat_weighted_mean` — plus the
+fault face (parent-epoch fencing, per-tier quorum, the root masking a
+failed aggregator's row) and the 3-role merged trace
+(root -> aggregator -> client under one trace id,
+``tools/trace_merge.py --check``).
+
+Dyadic inputs are the point, not a convenience: all values are small
+integers times powers of two, so every f32 add in either grouping is
+EXACT and the single division at the root sees identical operands. Real
+training deltas differ between the groupings by ~1 ulp (the adds round);
+the pins hold the associativity contract, not a fluke of one input.
+"""
+
+import json
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+import jax
+import jax.numpy as jnp
+
+from fedtpu.config import (
+    DataConfig,
+    FedConfig,
+    OptimizerConfig,
+    RoundConfig,
+    validate_tier_config,
+)
+from fedtpu.core.round import flat_weighted_mean
+from fedtpu.ops import flat as flat_ops
+from fedtpu.transport import proto, sparse, wire
+from fedtpu.transport.aggregator import AggregatorServer, serve_aggregator
+from fedtpu.transport.service import TrainerStub, create_channel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import trace_merge  # noqa: E402
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# A tiny two-leaf surface: total = 40 real coordinates, padded to 128.
+TEMPLATE = {
+    "params": {
+        "bias": np.zeros((8,), np.float32),
+        "dense": np.zeros((4, 8), np.float32),
+    },
+    "batch_stats": {},
+}
+
+
+def dyadic_deltas(rng, num_clients):
+    """Client delta pytrees whose values are multiples of 1/4 with
+    max|leaf| pinned to 127/4 — so the int8 codec's per-leaf scale is
+    exactly 1/4 (a power of two) and quant/dequant round-trips exactly."""
+    out = []
+    for _ in range(num_clients):
+        tree = {"params": {}, "batch_stats": {}}
+        for name, leaf in TEMPLATE["params"].items():
+            vals = rng.integers(-126, 127, size=leaf.shape).astype(
+                np.float32
+            ) * np.float32(0.25)
+            vals.flat[0] = np.float32(31.75)  # 127 * 2^-2: pins the scale
+            tree["params"][name] = vals
+        out.append(tree)
+    return out
+
+
+def rows_from_payloads(layout, payloads, template=None, base=None):
+    """Decode encoded client replies into a fresh [N, P] flat buffer via
+    the aggregator's exact streaming paths; returns (rows, weights)."""
+    rows = np.zeros((len(payloads), layout.padded), np.float32)
+    weights = np.zeros((len(payloads),), np.float32)
+    for i, data in enumerate(payloads):
+        if sparse.is_sparse_payload(data):
+            extra = sparse.decode_into_row(data, layout.sizes, rows[i])
+        else:
+            extra = wire.decode_into_row(data, template, base, rows[i])
+        weights[i] = float(extra["num_examples"])
+    return rows, weights
+
+
+def tiered_mean(layout, rows, weights, groups):
+    """The full 2-tier pipeline on already-decoded rows: per-group
+    partial reduce -> FSP1 partial_flat record -> root decode into the
+    [aggregators, P] surface -> single combine."""
+    root_rows = np.zeros((len(groups), layout.padded), np.float32)
+    weight_sums = np.zeros((len(groups),), np.float32)
+    for g, idx in enumerate(groups):
+        sum_row, wsum = flat_ops.partial_reduce_rows(
+            jnp.asarray(rows[list(idx)]), jnp.asarray(weights[list(idx)])
+        )
+        record = sparse.encode_partial_flat(
+            np.asarray(sum_row)[: layout.total], layout.sizes,
+            extra={"weight_sum": np.float32(float(wsum)),
+                   "clients": np.int64(len(idx))},
+        )
+        extra = sparse.decode_into_row(record, layout.sizes, root_rows[g])
+        weight_sums[g] = float(extra["weight_sum"])
+    return np.asarray(flat_ops.combine_partial_rows(
+        jnp.asarray(root_rows), jnp.asarray(weight_sums)
+    ))
+
+
+def encode_clients(codec, deltas, weights, base=None):
+    payloads = []
+    for delta, w in zip(deltas, weights):
+        extra = {"num_examples": np.float32(w)}
+        if codec == "topk":
+            payloads.append(
+                sparse.encode_topk_flat(delta, 1.0, extra=extra)[0]
+            )
+        elif codec == "int8":
+            payloads.append(sparse.encode_int8_flat(delta, extra=extra)[0])
+        else:  # dense: full weights = base + delta, wire-framed
+            tree = {
+                "params": {
+                    k: base["params"][k] + delta["params"][k]
+                    for k in base["params"]
+                },
+                "batch_stats": {},
+                "num_examples": np.float32(w),
+            }
+            payloads.append(wire.encode(tree))
+    return payloads
+
+
+# ------------------------------------------------ exactness / parity pins
+@pytest.mark.parametrize("codec", ["dense", "int8", "topk"])
+def test_two_tier_parity_bitwise(codec):
+    """The acceptance pin: 6 clients through codec encode -> stream decode
+    -> 2 leaf partial reduces -> partial_flat wire -> root combine equals
+    the one-tier flat weighted mean BYTE FOR BYTE."""
+    rng = np.random.default_rng(7)
+    deltas = dyadic_deltas(rng, 6)
+    weights = [1.0, 2.0, 4.0, 8.0, 1.0, 2.0]  # powers of two: exact w*x
+    layout = flat_ops.make_layout(TEMPLATE)
+    # Dyadic base (1.0 everywhere): base + delta and the decode-side
+    # subtraction are both exact in f32.
+    base = {
+        "params": {
+            k: np.ones_like(v) for k, v in TEMPLATE["params"].items()
+        },
+        "batch_stats": {},
+    }
+    payload_template = dict(TEMPLATE, num_examples=np.zeros((), np.float32))
+    payloads = encode_clients(codec, deltas, weights, base=base)
+    rows, got_w = rows_from_payloads(
+        layout, payloads, template=payload_template, base=base
+    )
+    assert got_w.tolist() == weights
+
+    flat = np.asarray(
+        flat_weighted_mean(jnp.asarray(rows), jnp.asarray(got_w))
+    )
+    two_tier = tiered_mean(layout, rows, got_w, [(0, 1, 2), (3, 4, 5)])
+    assert two_tier.tobytes() == flat.tobytes()
+    # The mean is non-trivial (decode really reconstructed the values).
+    assert np.abs(flat[: layout.total]).max() > 0
+
+
+def test_partial_reduce_grouping_invariance():
+    """Associativity directly: ANY grouping of exact-dyadic rows into
+    tiers combines to the identical bytes — including the degenerate
+    1-aggregator grouping, which IS flat_weighted_mean's program."""
+    rng = np.random.default_rng(3)
+    rows = (rng.integers(-512, 513, size=(8, 256)).astype(np.float32)
+            * np.float32(0.125))
+    weights = np.asarray([1, 2, 4, 2, 1, 8, 4, 2], np.float32)
+    flat = np.asarray(
+        flat_weighted_mean(jnp.asarray(rows), jnp.asarray(weights))
+    ).tobytes()
+    for groups in [
+        [(0, 1, 2, 3, 4, 5, 6, 7)],
+        [(0, 1, 2, 3), (4, 5, 6, 7)],
+        [(0,), (1, 2), (3, 4, 5), (6, 7)],
+    ]:
+        root_rows = np.zeros((len(groups), 256), np.float32)
+        wsums = np.zeros((len(groups),), np.float32)
+        for g, idx in enumerate(groups):
+            s, w = flat_ops.partial_reduce_rows(
+                jnp.asarray(rows[list(idx)]),
+                jnp.asarray(weights[list(idx)]),
+            )
+            root_rows[g] = np.asarray(s)
+            wsums[g] = float(w)
+        combined = np.asarray(flat_ops.combine_partial_rows(
+            jnp.asarray(root_rows), jnp.asarray(wsums)
+        )).tobytes()
+        assert combined == flat, f"grouping {groups} diverged"
+
+
+def test_partial_flat_record_roundtrip_and_validation():
+    layout = flat_ops.make_layout(TEMPLATE)
+    row = np.arange(layout.total, dtype=np.float32)
+    rec = sparse.encode_partial_flat(
+        row, layout.sizes, extra={"weight_sum": np.float32(5.0)}
+    )
+    assert sparse.is_sparse_payload(rec)
+    out = np.zeros((layout.padded,), np.float32)
+    extra = sparse.decode_into_row(rec, layout.sizes, out)
+    assert float(extra["weight_sum"]) == 5.0
+    np.testing.assert_array_equal(out[: layout.total], row)
+    assert not out[layout.total:].any()  # pad stays clean
+    with pytest.raises(ValueError):
+        sparse.encode_partial_flat(row[:-1], layout.sizes)
+    # A record for a DIFFERENT layout must be rejected, not scattered.
+    other = sparse.encode_partial_flat(
+        np.zeros((8,), np.float32), (8,), extra={}
+    )
+    with pytest.raises(wire.WireError):
+        sparse.decode_into_row(other, layout.sizes, out)
+
+
+def test_partial_row_sharding_divides_rows():
+    from fedtpu.parallel.mesh import partial_row_sharding
+
+    sharding = partial_row_sharding(4)
+    # On any device count, the mesh size divides the row count (falls back
+    # toward 1 device rather than failing on awkward aggregator counts).
+    assert 4 % sharding.mesh.devices.size == 0
+    arr = jax.device_put(np.zeros((4, 256), np.float32), sharding)
+    assert arr.sharding.is_equivalent_to(sharding, ndim=2)
+
+
+def test_validate_tier_config_rejects_incompatible_features():
+    ok = FedConfig(num_clients=2, tier_fanout=2, delta_layout="flat")
+    validate_tier_config(ok, "test")
+    import dataclasses
+
+    for bad in [
+        dataclasses.replace(ok, tier_fanout=-1),
+        dataclasses.replace(ok, aggregator="trimmed_mean"),
+        dataclasses.replace(ok, dp_clip_norm=1.0),
+        dataclasses.replace(ok, delta_layout="per_leaf"),
+    ]:
+        with pytest.raises(ValueError):
+            validate_tier_config(bad, "test")
+
+
+# --------------------------------------------------- fault face (real gRPC)
+def sim_cfg(**fed_kw) -> RoundConfig:
+    fed = FedConfig(num_clients=2, delta_layout="flat", **fed_kw)
+    return RoundConfig(fed=fed)
+
+
+@pytest.fixture()
+def sim_aggregator():
+    """One aggregator over real localhost gRPC whose cohort is a mutable
+    payload list (the CohortSource seam)."""
+    holder = {"payloads": []}
+    server, agg = serve_aggregator(
+        f"localhost:{free_port()}",
+        sim_cfg(),
+        cohort_source=lambda rnd, base, world: list(holder["payloads"]),
+        template=TEMPLATE,
+    )
+    stub = TrainerStub(create_channel(agg.identity))
+    yield holder, agg, stub
+    server.stop(0)
+
+
+def _fill(holder, n=3):
+    rng = np.random.default_rng(11)
+    holder["payloads"] = encode_clients(
+        "topk", dyadic_deltas(rng, n), [8.0] * n
+    )
+
+
+def test_aggregator_partial_over_grpc(sim_aggregator):
+    holder, agg, stub = sim_aggregator
+    _fill(holder, n=3)
+    reply = stub.SubmitPartial(
+        proto.SubmitPartialRequest(rank_base=0, world=3, round=0, epoch=1),
+        timeout=30,
+    )
+    assert reply.clients == 3
+    layout = agg._flat_layout
+    out = np.zeros((layout.padded,), np.float32)
+    extra = sparse.decode_into_row(reply.record, layout.sizes, out)
+    assert float(extra["weight_sum"]) == 24.0  # 3 clients x 8 examples
+    assert int(extra["clients"]) == 3
+    assert agg.status_snapshot()["last_partial"]["clients"] == 3
+    assert agg.status_snapshot()["mem"]["tier"] == "leaf"
+
+
+def test_aggregator_fences_stale_coordinator(sim_aggregator):
+    holder, agg, stub = sim_aggregator
+    _fill(holder)
+    stub.SubmitPartial(
+        proto.SubmitPartialRequest(rank_base=0, world=3, round=0, epoch=2),
+        timeout=30,
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        stub.SubmitPartial(
+            proto.SubmitPartialRequest(
+                rank_base=0, world=3, round=1, epoch=1
+            ),
+            timeout=30,
+        )
+    assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "STALE_COORDINATOR" in err.value.details()
+    assert agg._max_epoch == 2
+
+
+def test_aggregator_aborts_sub_quorum_cohort(sim_aggregator):
+    holder, agg, stub = sim_aggregator
+    holder["payloads"] = []  # the whole cohort is gone this round
+    with pytest.raises(grpc.RpcError) as err:
+        stub.SubmitPartial(
+            proto.SubmitPartialRequest(
+                rank_base=0, world=3, round=0, epoch=1
+            ),
+            timeout=30,
+        )
+    assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "SUB_QUORUM" in err.value.details()
+
+
+# ------------------------------------------- root composition (real model)
+def real_cfg(tier_fanout, num_clients=2, telemetry="off") -> RoundConfig:
+    return RoundConfig(
+        model="mlp",
+        num_classes=10,
+        opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+        data=DataConfig(
+            dataset="synthetic", batch_size=8, eval_batch_size=8,
+            num_examples=256,
+        ),
+        fed=FedConfig(
+            num_clients=num_clients, num_rounds=2,
+            delta_layout="flat", tier_fanout=tier_fanout,
+            telemetry=telemetry,
+        ),
+        steps_per_round=2,
+    )
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_root_masks_failed_aggregator_row():
+    """One leaf answers with a healthy partial; the other's whole cohort
+    is dead, so its SubmitPartial aborts typed SUB_QUORUM. The root must
+    commit the round from the surviving tier with the dead tier's row
+    masked — exactly a failed client, one level up."""
+    from fedtpu.transport.federation import PrimaryServer
+
+    cfg = real_cfg(tier_fanout=3)
+    holders = [{"payloads": []}, {"payloads": []}]
+    servers, aggs, addrs = [], [], []
+    try:
+        for holder in holders:
+            addr = f"localhost:{free_port()}"
+            server, agg = serve_aggregator(
+                addr, cfg,
+                cohort_source=(
+                    lambda rnd, base, world, h=holder: list(h["payloads"])
+                ),
+            )
+            servers.append(server)
+            aggs.append(agg)
+            addrs.append(addr)
+        layout = aggs[0]._flat_layout
+
+        def leaf_payloads(n, seed):
+            rng = np.random.default_rng(seed)
+            out = []
+            for i in range(n):
+                flat = np.zeros((layout.total,), np.float32)
+                delta = flat_ops.unpack(
+                    layout, jnp.asarray(
+                        np.pad(flat, (0, layout.pad))
+                    )
+                )
+                out.append(sparse.encode_topk_flat(
+                    delta, 1.0,
+                    extra={"num_examples": np.float32(8.0)},
+                )[0])
+            return out
+
+        holders[0]["payloads"] = leaf_payloads(3, seed=1)
+        # holders[1] stays empty -> SUB_QUORUM abort on that leaf.
+        primary = PrimaryServer(cfg, addrs)
+        rec = primary.round()
+        assert not rec.get("aborted")
+        assert rec["tier_fanout"] == 3
+        assert rec["world"] == 6  # 2 aggregator seats x fanout
+        assert rec["participants"] == 1  # the SUB_QUORUM tier dropped out
+        assert rec["aggregated"] == 1  # ...and its row stayed masked
+        assert rec["clients_aggregated"] == 3  # the live cohort only
+        assert primary.status_snapshot()["mem"]["tier"] == "root"
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_three_role_trace_merges_under_root_round(tmp_path):
+    """Root -> aggregator -> client over real gRPC with telemetry=trace:
+    the merged doc carries ONE trace id and every client_train span roots
+    in the ROOT's round span across both process hops."""
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    cfg = real_cfg(tier_fanout=2, telemetry="trace")
+    stops = []
+    try:
+        client_addrs, agents = [], []
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, agent = serve_client(addr, cfg, seed=i)
+            stops.append(server)
+            client_addrs.append(addr)
+            agents.append(agent)
+        agg_addr = f"localhost:{free_port()}"
+        agg_server, agg = serve_aggregator(
+            agg_addr, cfg, clients=client_addrs
+        )
+        stops.append(agg_server)
+        primary = PrimaryServer(cfg, [agg_addr])
+        for _ in range(2):
+            rec = primary.round()
+            assert not rec.get("aborted")
+            assert rec["clients_aggregated"] == 2
+
+        coord_id = primary.telemetry.tracer.trace_id
+        assert agg.telemetry.tracer.trace_id == coord_id
+        paths = [str(tmp_path / "primary.json")]
+        primary.telemetry.export_trace(paths[0])
+        paths.append(str(tmp_path / "aggregator.json"))
+        agg.telemetry.export_trace(paths[1])
+        for i, agent in enumerate(agents):
+            tel = agent.trainer.telemetry
+            assert tel.tracer.trace_id == coord_id
+            paths.append(str(tmp_path / f"client{i}.json"))
+            tel.export_trace(paths[-1])
+    finally:
+        for s in stops:
+            s.stop(0)
+
+    merged = str(tmp_path / "merged.json")
+    assert trace_merge.main(paths + ["-o", merged, "--check"]) == 0
+    with open(merged) as fh:
+        doc = json.load(fh)
+    assert doc["metadata"]["trace_ids"] == [coord_id]
+    index = trace_merge.span_index(doc)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    # The tier's own phases made it into the one timeline.
+    assert {"submit_partial", "collect", "partial_reduce"} <= names
+    trains = [
+        e for e in doc["traceEvents"] if e.get("name") == "client_train"
+    ]
+    assert len(trains) >= 4  # 2 clients x 2 rounds
+    for e in trains:
+        root = trace_merge.root_of(index, e)
+        assert root is not None and root["name"] == "round"
+        assert root["args"]["span_id"].startswith("primary/")
+        # Immediate remote parent: the AGGREGATOR's per-client rpc span.
+        parent = index[e["args"]["parent_id"]]
+        assert parent["name"] == "client_rpc"
+        assert parent["args"]["span_id"].startswith("aggregator")

@@ -901,3 +901,71 @@ def test_unreachable_diagnostic_carries_predicted_roofline(
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0.0
     assert "predicted_bytes_drop" not in out
+
+
+# --------------------------------------------- hierarchical fan-in (PR 14)
+def test_fanin_microbench_contract(bench, monkeypatch, tmp_path):
+    """--fanin-microbench at a seconds-scale config: schema + artifact
+    emission over REAL localhost gRPC aggregators (the 10k-clients/round
+    acceptance gate itself is pinned by the committed
+    artifacts/FANIN_MICROBENCH.json run)."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_FB_DIM", "4096")
+    monkeypatch.setenv("FEDTPU_FB_COHORT", "40")
+    monkeypatch.setenv("FEDTPU_FB_AGGS", "2,4")
+    monkeypatch.setenv("FEDTPU_FB_FIXED_AGGS", "2")
+    monkeypatch.setenv("FEDTPU_FB_COHORTS", "20,40")
+    monkeypatch.setenv("FEDTPU_FB_ROUNDS", "2")
+    result = bench._fanin_microbench()
+    assert result["metric"] == "fanin_microbench"
+    assert result["flat_coords"] == 4096
+    assert result["rounds_per_config"] == 2
+    scale_out = result["sweeps"]["scale_out_fixed_cohort"]
+    fan_in = result["sweeps"]["fan_in_fixed_aggregators"]
+    assert [r["aggregators"] for r in scale_out] == [2, 4]
+    assert [r["cohort"] for r in scale_out] == [40, 40]
+    assert [r["cohort"] for r in fan_in] == [20, 40]
+    for row in scale_out + fan_in:
+        # Every simulated client produced a decoded reply each round.
+        assert row["clients"] == row["aggregators"] * row["cohort"]
+        assert row["serial_wall_s"] > 0
+        assert row["root_decode_combine_s"] > 0
+        assert row["leaf_max_s"] > 0
+        # The deployed-topology wall: root work + slowest single leaf.
+        assert row["critical_path_s"] == pytest.approx(
+            row["root_decode_combine_s"] + row["leaf_max_s"], rel=0.01
+        )
+        assert row["critical_path_s"] <= row["serial_wall_s"]
+    gates = result["gates"]
+    assert gates["critical_path_sublinear"] == (
+        gates["critical_path_exponent_vs_clients"] < 1.0
+    )
+    assert gates["root_work_o_aggregators"] == (
+        gates["root_work_ratio_across_cohort_growth"] < 2.0
+    )
+    assert result["value"] == gates["critical_path_exponent_vs_clients"]
+    path = os.path.join(str(art), "FANIN_MICROBENCH.json")
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
+def test_fanin_microbench_committed_gate():
+    """The committed artifact is the PR's acceptance evidence: 10k
+    simulated clients/round through a real-gRPC 2-tier topology, root
+    decode+combine work O(aggregators) not O(clients), and round
+    wall-clock sublinear in total clients."""
+    result = _committed_artifact("FANIN_MICROBENCH.json")
+    assert result["metric"] == "fanin_microbench"
+    assert result["max_clients_per_round"] >= 10000
+    gates = result["gates"]
+    assert gates["critical_path_sublinear"] is True
+    assert gates["critical_path_exponent_vs_clients"] < 1.0
+    assert gates["root_work_o_aggregators"] is True
+    assert gates["root_work_ratio_across_cohort_growth"] < 2.0
+    # The fan-in sweep really grew clients ~4x while root work stayed flat.
+    assert gates["root_client_growth_ratio"] >= 3.5

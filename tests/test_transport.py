@@ -74,6 +74,85 @@ def test_epoch_fields_roundtrip_and_stay_wire_compatible():
     assert proto.PingRequest.decode(b"\x0a\x01x").epoch == -1
 
 
+def test_submit_partial_messages_stay_wire_compatible():
+    """The hierarchical-aggregation RPC (PR 14) is ADDITIVE: a brand-new
+    method with its own messages, proto3 omit-zero throughout — so tiered
+    builds put zero new bytes on any legacy RPC, a default-valued request
+    encodes to b"" exactly, and a legacy peer that never registered
+    SubmitPartial answers UNIMPLEMENTED (the root treats that as a dead
+    aggregator, not a protocol error)."""
+    for msg in [
+        proto.SubmitPartialRequest(),
+        proto.SubmitPartialRequest(rank_base=0, world=4, round=0, epoch=0),
+        proto.SubmitPartialRequest(
+            rank_base=2**20, world=2**24, round=17, epoch=3
+        ),
+    ]:
+        assert proto.SubmitPartialRequest.decode(msg.encode()) == msg
+    # The unset request IS the empty message (all four fields omit-zero:
+    # rank_base/world plain zeros, round/epoch the +1 pattern).
+    assert proto.SubmitPartialRequest().encode() == b""
+    got = proto.SubmitPartialRequest.decode(b"")
+    assert (got.rank_base, got.world, got.round, got.epoch) == (0, 0, -1, -1)
+    # Byte pin: the exact varint layout is frozen — field 1/2 plain,
+    # field 3/4 shifted by one so epoch 0 survives omit-zero.
+    pinned = proto.SubmitPartialRequest(
+        rank_base=4, world=16, round=3, epoch=2
+    )
+    assert pinned.encode().hex() == "0804101018042003"
+    # round=0 / epoch=0 are real values, distinct from absent.
+    z = proto.SubmitPartialRequest.decode(
+        proto.SubmitPartialRequest(round=0, epoch=0).encode()
+    )
+    assert (z.round, z.epoch) == (0, 0)
+
+    for reply in [
+        proto.SubmitPartialReply(),
+        proto.SubmitPartialReply(record=bytes(range(256)), clients=12),
+    ]:
+        assert proto.SubmitPartialReply.decode(reply.encode()) == reply
+    assert proto.SubmitPartialReply().encode() == b""
+    assert proto.SubmitPartialReply(
+        record=b"r", clients=3
+    ).encode() == b"\x0a\x01r\x10\x03"
+
+
+def test_legacy_peer_without_submit_partial_answers_unimplemented():
+    """Dial a server whose servicer predates the tier (no SubmitPartial
+    handler): the call must fail UNIMPLEMENTED — the typed signal the
+    root's retry policy treats as a dead peer, never a crash."""
+    grpc = pytest.importorskip("grpc")
+    from fedtpu.transport.service import (
+        TrainerServicer, TrainerStub, create_channel, create_server,
+    )
+
+    class LegacyServicer(TrainerServicer):
+        def SendModel(self, request, context):
+            return proto.SendModelReply(reply=b"ok")
+
+        def StartTrain(self, request, context):
+            return proto.TrainReply(message=b"m")
+
+        def HeartBeat(self, request, context):
+            return proto.HeartBeatResponse(status=1)
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    addr = f"localhost:{s.getsockname()[1]}"
+    s.close()
+    server = create_server(addr, LegacyServicer())
+    server.start()
+    try:
+        stub = TrainerStub(create_channel(addr))
+        # The legacy surface still answers.
+        assert stub.HeartBeat(proto.Request(), timeout=10).status == 1
+        with pytest.raises(grpc.RpcError) as err:
+            stub.SubmitPartial(proto.SubmitPartialRequest(), timeout=10)
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        server.stop(0)
+
+
 def test_bytes_messages_roundtrip():
     payload = bytes(range(256)) * 100  # non-UTF8 on purpose
     for cls, field in [

@@ -17,7 +17,13 @@ the PARTITION-HEAL soak (:func:`run_partition_soak`): symmetric,
 asymmetric (split-brain fork) and gray-flap legs driven by ``partition``/
 ``flaky`` chaos rules, gated on epoch fencing leaving exactly one
 surviving exact-cover lineage with zero transient client deaths and
-bounded failover churn — ``artifacts/PARTITION_SOAK.json``.
+bounded failover churn — ``artifacts/PARTITION_SOAK.json``. ``--tiered``
+runs the HIERARCHICAL-AGGREGATION leg (:func:`run_tiered_soak`): a
+2-tier real-gRPC topology (leaf aggregators as genuine subprocesses of
+``fedtpu.cli.server --role aggregator``) under transient SubmitPartial
+faults, with one leaf aggregator SIGKILLed mid-round — the root must
+commit with the tier's rows masked, zero transient client deaths, and
+an exact-cover lineage — ``artifacts/TIERED_SOAK.json``.
 
 What it proves (the acceptance spine of the chaos/resilience PR;
 docs/FAULT_TOLERANCE.md):
@@ -1823,6 +1829,275 @@ def run_partition_soak(rounds: int = 20, clients: int = 3,
     }
 
 
+# --------------------------------------------------------------- tiered soak
+def _scrape_statusz(port: int) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statusz", timeout=5
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+def run_tiered_soak(
+    rounds: int = 12,
+    aggregators: int = 2,
+    fanout: int = 2,
+    kill_round: int = 5,
+    error_p: float = 0.15,
+    retries: int = 6,
+    seed: int = 7,
+    verbose: bool = True,
+) -> dict:
+    """The hierarchical-aggregation chaos leg (acceptance spine of the
+    multi-tier PR; docs/ARCHITECTURE.md §Multi-tier, docs/OPERATIONS.md
+    §Hierarchical aggregation): a 2-tier topology over the LIVE gRPC
+    transport — leaf clients in THIS process, every leaf
+    ``AggregatorServer`` a real subprocess of ``fedtpu.cli.server --role
+    aggregator``, the root an in-process ``PrimaryServer`` in tier mode —
+    with seeded transient faults on the root->aggregator ``SubmitPartial``
+    link throughout and one leaf aggregator SIGKILLed MID-ROUND. Gates:
+
+    1. **The root commits through the kill with the tier's rows masked.**
+       The kill round (and every round after it) commits with
+       ``participants == aggregators - 1`` and ``clients_aggregated ==
+       (aggregators - 1) * fanout`` — the dead tier becomes one masked
+       row, never an abort, never a hang (``round_quorum`` is per-tier).
+    2. **Zero transient client deaths.** The tier-link faults retry away
+       (``fedtpu_rpc_retries_total > 0``) and the only death anywhere is
+       the SIGKILLed aggregator itself: root-side
+       ``fedtpu_ft_client_deaths_total == 1`` (the aggregator peer), and
+       every SURVIVING aggregator's roster shows zero dead cohort
+       clients.
+    3. **Exact-cover lineage.** Committed round records cover exactly
+       ``0..rounds-1``, strictly monotone — the mid-round process death
+       costs capacity, not lineage.
+
+    Writes ``artifacts/TIERED_SOAK.json`` via ``--tiered``. The fast
+    in-process masking drill is tier-1 in ``tests/test_aggregator.py``
+    (``test_root_masks_failed_aggregator_row``).
+    """
+    import threading
+
+    from fedtpu.config import RetryPolicy
+    from fedtpu.ft.chaos import parse_spec
+    from fedtpu.obs import parse_prometheus_text, prometheus_text
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    assert aggregators >= 2, "need a surviving tier to mask against"
+    assert 2 <= kill_round <= rounds - 2, (kill_round, rounds)
+    t_start = time.monotonic()
+
+    def note(msg):
+        if verbose:
+            print(f"[tiered] {msg}", flush=True)
+
+    # consec=2 keeps the worst failure run strictly under the retry
+    # budget: the tier-link faults are transient BY CONSTRUCTION, so the
+    # only mark_failed of the soak is the genuine process death.
+    spec = f"error@SubmitPartial:p={error_p},consec=2,seed={seed}"
+    assert retries > 3, "retry budget must exceed the consec cap"
+    cfg = _tiny_cfg(
+        aggregators, rounds,
+        delta_layout="flat",
+        tier_fanout=fanout,
+        round_quorum=0.5,
+        retry=RetryPolicy(max_attempts=retries, backoff_s=0.02),
+    )
+    result: dict = {"config": {
+        "rounds": rounds, "aggregators": aggregators, "fanout": fanout,
+        "kill_round": kill_round, "error_p": error_p, "retries": retries,
+        "seed": seed, "chaos_spec": spec,
+    }}
+
+    servers, agents, client_addrs = [], [], []
+    procs, agg_addrs, obs_ports = [], [], []
+    try:
+        for i in range(aggregators * fanout):
+            addr = f"localhost:{free_port()}"
+            server, agent = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            agents.append(agent)
+            client_addrs.append(addr)
+        note(f"{len(client_addrs)} leaf clients up")
+
+        for j in range(aggregators):
+            cohort = client_addrs[j * fanout:(j + 1) * fanout]
+            port, obs_port = free_port(), free_port()
+            cmd = [
+                sys.executable, "-m", "fedtpu.cli.server",
+                "--role", "aggregator", "--platform", "cpu",
+                "--model", "mlp", "--dataset", "synthetic",
+                "--num-examples", "256", "--batch-size", "8",
+                "--eval-batch-size", "8",
+                "--clients", ",".join(cohort),
+                "--listen", f"localhost:{port}",
+                "--delta-layout", "flat",
+                "--tier-fanout", str(fanout),
+                "--obs-port", str(obs_port),
+                "--seed", "0",
+            ]
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            procs.append(subprocess.Popen(
+                cmd, cwd=REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+            agg_addrs.append(f"localhost:{port}")
+            obs_ports.append(obs_port)
+        # Wait for every aggregator's obs endpoint (jax import is the
+        # long pole) before the root starts pulling.
+        deadline = time.monotonic() + 120
+        for j, obs_port in enumerate(obs_ports):
+            while True:
+                assert procs[j].poll() is None, (
+                    f"aggregator {j} died during startup"
+                )
+                try:
+                    snap = _scrape_statusz(obs_port)
+                    assert snap["mem"]["tier"] == "leaf", snap
+                    break
+                except (OSError, KeyError):
+                    assert time.monotonic() < deadline, (
+                        f"aggregator {j} never served /statusz"
+                    )
+                    time.sleep(0.25)
+        note(f"{aggregators} leaf aggregators up (subprocesses), "
+             f"cohorts of {fanout}")
+
+        victim = aggregators - 1
+        killed_at = []
+        armed = threading.Event()
+
+        def killer():
+            armed.wait()
+            # The previous round just committed; the root is already
+            # inside round `kill_round`'s broadcast/fan-out by the time
+            # this fires (a leaf round walls hundreds of ms), so the
+            # SIGKILL lands with the tier's SubmitPartial in flight.
+            time.sleep(0.05)
+            procs[victim].kill()
+            killed_at.append(time.monotonic())
+            note(f"aggregator {victim} ({agg_addrs[victim]}) SIGKILLed "
+                 "mid-round")
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        records = []
+
+        def on_round(r, rec):
+            records.append(dict(rec))
+            if not rec.get("aborted") and int(rec["round"]) == kill_round - 1:
+                armed.set()
+
+        primary = PrimaryServer(cfg, agg_addrs, chaos=parse_spec(spec))
+        note(f"root: {rounds} rounds over {aggregators} tiers, kill at "
+             f"round {kill_round}, tier-link chaos {spec!r}")
+        primary.run(num_rounds=rounds, on_round=on_round)
+        kt.join(timeout=10)
+        assert killed_at, "the kill never fired"
+
+        committed = [r for r in records if not r.get("aborted")]
+        lineage = [int(r["round"]) for r in committed]
+        result["lineage"] = {
+            "committed": len(committed),
+            "aborted": len(records) - len(committed),
+            "exact_cover": lineage == list(range(rounds)),
+        }
+        assert result["lineage"]["exact_cover"], (
+            f"lineage not exactly 0..{rounds - 1}: {lineage}"
+        )
+
+        # ---- the masked-tier gate ----
+        masked = [int(r["round"]) for r in committed
+                  if r["participants"] < aggregators]
+        result["first_masked_round"] = masked[0] if masked else None
+        assert masked and masked[0] == kill_round, (
+            f"masking started at {masked[:1]}, expected round {kill_round}"
+        )
+        for rec in committed:
+            r = int(rec["round"])
+            want = aggregators - 1 if r >= kill_round else aggregators
+            assert rec["participants"] == want, (r, rec)
+            assert rec["aggregated"] == want, (r, rec)
+            assert rec["clients_aggregated"] == want * fanout, (r, rec)
+            # Seat capacity (and so the rank/world data partition) is
+            # stable across the death: the tier is masked, not re-tiled.
+            assert rec["world"] == aggregators * fanout, (r, rec)
+            assert rec["tier_fanout"] == fanout, (r, rec)
+        result["participants_by_round"] = [
+            [int(r["round"]), int(r["participants"])] for r in committed
+        ]
+        result["clients_aggregated_by_round"] = [
+            [int(r["round"]), int(r["clients_aggregated"])]
+            for r in committed
+        ]
+
+        # ---- zero transient deaths; the tier-link chaos really fired ----
+        parsed = parse_prometheus_text(
+            prometheus_text(primary.telemetry.registry)
+        )
+
+        def msum(name):
+            return sum(parsed.get(name, {}).values())
+
+        result["observed"] = {
+            "root_peer_deaths": msum("fedtpu_ft_client_deaths_total"),
+            "rpc_retries": msum("fedtpu_rpc_retries_total"),
+            "chaos_injected": msum("fedtpu_chaos_injected_total"),
+        }
+        obs = result["observed"]
+        assert obs["root_peer_deaths"] == 1, (
+            f"{obs['root_peer_deaths']} root-side deaths — transient "
+            "tier-link faults killed a live aggregator (expected exactly "
+            "the SIGKILLed one)"
+        )
+        assert obs["rpc_retries"] > 0 and obs["chaos_injected"] > 0, (
+            "the tier-link chaos never exercised the SubmitPartial retry "
+            "path"
+        )
+        survivors = []
+        for j in range(aggregators):
+            if j == victim:
+                continue
+            snap = _scrape_statusz(obs_ports[j])
+            agg_metrics = _scrape_metrics(obs_ports[j])
+            dead = snap["clients"]["dead"]
+            cohort_deaths = sum(
+                agg_metrics.get("fedtpu_ft_client_deaths_total", {}).values()
+            )
+            assert dead == 0 and cohort_deaths == 0, (
+                f"aggregator {j}: {dead} dead cohort clients "
+                f"({cohort_deaths} death events) — the tier kill cascaded"
+            )
+            survivors.append({
+                "aggregator": agg_addrs[j],
+                "tier": snap["mem"]["tier"],
+                "round_seen": snap["round"],
+                "cohort_active": snap["clients"]["active"],
+                "cohort_dead": dead,
+            })
+        result["surviving_tiers"] = survivors
+
+        # Surviving-cohort clients finished with finite evals (they were
+        # served through the death without interruption).
+        evals = []
+        for i, agent in enumerate(agents):
+            if i // fanout == victim:
+                continue  # orphaned mid-soak by design
+            assert agent.last_eval is not None, "client never evaluated"
+            loss, acc = agent.last_eval
+            assert loss == loss and abs(loss) != float("inf"), loss
+            evals.append({"loss": loss, "acc": acc})
+        result["surviving_final_evals"] = evals
+        result["wall_s"] = round(time.monotonic() - t_start, 2)
+        result["ok"] = True
+        return result
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for s in servers:
+            s.stop(0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", default=20, type=int)
@@ -1887,10 +2162,43 @@ def main(argv=None) -> int:
     ap.add_argument("--partition-round", default=6, type=int,
                     help="lineage round after which each leg's fault "
                     "window opens")
+    ap.add_argument(
+        "--tiered", action="store_true",
+        help="run the hierarchical-aggregation chaos leg instead: a "
+        "2-tier real-gRPC topology (leaf aggregators as subprocesses of "
+        "fedtpu.cli.server --role aggregator) under transient "
+        "SubmitPartial faults, one leaf aggregator SIGKILLed mid-round; "
+        "gates masked-tier commits at the root, zero transient client "
+        "deaths, exact-cover lineage; writes artifacts/TIERED_SOAK.json",
+    )
+    ap.add_argument("--tiered-rounds", default=12, type=int)
+    ap.add_argument("--tiered-kill-round", default=5, type=int)
+    ap.add_argument("--aggregators", default=2, type=int)
+    ap.add_argument("--fanout", default=2, type=int)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.tiered:
+        try:
+            result = run_tiered_soak(
+                rounds=args.tiered_rounds,
+                aggregators=args.aggregators,
+                fanout=args.fanout,
+                kill_round=args.tiered_kill_round,
+                error_p=args.error_p if args.error_p != 0.3 else 0.15,
+                retries=max(args.retries, 4),
+                seed=args.seed,
+            )
+        except AssertionError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}))
+            return 1
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "TIERED_SOAK.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        print(json.dumps(result))
+        return 0
     if args.partition:
         try:
             result = run_partition_soak(

@@ -168,6 +168,31 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
         # replaces it with a gather/copy regroup.
         np.ascontiguousarray(mega_src.reshape(2, 4 * 32, 32, 32, 3))
 
+    # Hierarchical-aggregation host costs (PR: sub-aggregator tier). The
+    # two per-round steps the tier adds OUTSIDE the jitted reduce: the
+    # leaf's [cohort, P] -> one-row weighted fold (numpy stands in for the
+    # jitted fedtpu.ops.flat.partial_reduce_rows so the harness stays
+    # jax-free), and assembling the FSP1 partial_flat record — one O(P)
+    # row copy + header/CRC framing, the wire cost of SubmitPartial's
+    # reply. A regression here means the leaf started re-materializing
+    # rows per client or the record grew a per-coordinate encode loop.
+    fold_rows = np.ones((16, 4096), dtype=np.float32)
+    fold_w = np.arange(1.0, 17.0, dtype=np.float32)
+
+    def partial_reduce_fold_one():
+        (fold_rows * fold_w[:, None]).sum(axis=0)
+        fold_w.sum()
+
+    import struct
+    import zlib
+
+    partial_row = np.arange(32768, dtype=np.float32)
+
+    def submit_partial_frame_one():
+        payload = partial_row.tobytes()
+        struct.pack("<4sBBI", b"FSP1", 1, 0,
+                    zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
     def span_one():
         with tel.span("perf_ci", round=0):
             pass
@@ -196,6 +221,8 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
         ("gap_analyze_us", lambda: gap_analyze.analyze(doc), 20, None),
         ("mixed_precision_cast_us", cast_one, 200, None),
         ("megabatch_reshape_us", megabatch_reshape_one, 5000, None),
+        ("partial_reduce_fold_us", partial_reduce_fold_one, 500, None),
+        ("submit_partial_frame_us", submit_partial_frame_one, 500, None),
     ]
 
 

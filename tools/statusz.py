@@ -43,14 +43,28 @@ def render_line(status: dict) -> str:
         parts.append(f"phase={status['phase']}")
     clients = status.get("clients")
     if isinstance(clients, dict):
-        alive = clients.get("alive", [])
-        dead = clients.get("dead", [])
-        parts.append(f"alive={len(alive)}/{len(alive) + len(dead)}")
-        if dead:
-            parts.append(f"dead={','.join(dead)}")
+        if isinstance(clients.get("active"), int):
+            # Aggregator snapshots carry roster COUNTS (active/dead/total),
+            # not address lists — the cohort can be large.
+            active, dead_n = clients["active"], int(clients.get("dead", 0))
+            parts.append(f"alive={active}/{active + dead_n}")
+        else:
+            alive = clients.get("alive", [])
+            dead = clients.get("dead", [])
+            parts.append(f"alive={len(alive)}/{len(alive) + len(dead)}")
+            if dead:
+                parts.append(f"dead={','.join(dead)}")
     elif isinstance(status.get("alive"), list):
         mask = status["alive"]
         parts.append(f"alive={sum(1 for a in mask if a)}/{len(mask)}")
+    mem = status.get("mem")
+    if isinstance(mem, dict) and mem.get("tier"):
+        # Hierarchical topology: which tier this process is (root/leaf,
+        # flat when one-tier) and the rows currently buffered toward its
+        # partial reduce — nonzero only mid-collect.
+        parts.append(f"tier={mem['tier']}")
+        if mem.get("partial_rows_buffered"):
+            parts.append(f"partial_rows={int(mem['partial_rows_buffered'])}")
     if status.get("heartbeat_misses"):
         parts.append(f"hb_miss={int(status['heartbeat_misses'])}")
     if status.get("seconds_since_primary_ping") is not None:

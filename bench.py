@@ -374,6 +374,248 @@ def _compression_microbench():
     }
 
 
+def _codec_frontier_microbench():
+    """``codec_frontier``: wire bytes vs fidelity across the codec family,
+    plus a convergence leg pinning the ≥10x operating point.
+
+    Two legs in one artifact (``artifacts/CODEC_FRONTIER_MICROBENCH.json``):
+
+    - **sweep**: every wire codec — dense / int8 / topk / rotq@{1,2,4,8}
+      bits / randk — encodes the SAME synthetic delta at the densenet
+      profile shape through the real ``fedtpu.transport.sparse`` / ``wire``
+      encoders (not an analytic byte model). Per codec: payload bytes,
+      reduction vs the dense baseline, encode/decode host-wall medians, and
+      one-shot reconstruction relative L2 error — the fidelity axis of the
+      frontier. One-shot error is the right sweep metric because it needs
+      no training loop; error-FEEDBACK fidelity (residual carried across
+      rounds) is what the convergence leg measures. rotq bytes include the
+      power-of-two pad its Hadamard rotation needs — the honest wire
+      number (~1.33x inflation at this shape, stamped as ``pad_ratio``).
+    - **convergence** (the headline ``value``): the engine trained twice
+      from the same seed — ``compression='none'`` vs the ≥10x operating
+      point (randk, small keep-fraction, error feedback on, flat layout) —
+      then evaluated on held-out synthetic test data. Per-round wire bytes
+      come from genuinely encoding the run's aggregate model delta through
+      ``sparse.encode_randk_flat`` vs a dense ``wire.encode`` of the same
+      payload (both byte counts are shape-deterministic, so one encode IS
+      the per-round figure). Gates, recorded in the JSON and pinned by
+      tests/test_bench.py against the committed artifact: wire-byte
+      ``reduction_x >= 10`` AND final test accuracy within
+      ``FEDTPU_CF_ACC_TOL`` (default 0.05) of the uncompressed run.
+
+    Env knobs (shrunk by tests/test_bench.py): FEDTPU_CF_MODEL / _REPS /
+    _FRACTION (sweep + convergence keep-fraction) / _CONV_CLIENTS /
+    _CONV_ROUNDS / _ACC_TOL. Run via ``python bench.py
+    --codec-frontier-microbench``; prints one JSON line and writes the
+    artifact.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedtpu import models as zoo
+    from fedtpu.config import (
+        DataConfig, FedConfig, OptimizerConfig, RoundConfig,
+    )
+    from fedtpu.core.engine import Federation
+    from fedtpu.data import load
+    from fedtpu.transport import sparse, wire
+
+    model_name = os.environ.get("FEDTPU_CF_MODEL", "densenet_cifar")
+    reps = int(os.environ.get("FEDTPU_CF_REPS", "3"))
+    fraction = float(os.environ.get("FEDTPU_CF_FRACTION", "0.05"))
+    conv_clients = int(os.environ.get("FEDTPU_CF_CONV_CLIENTS", "4"))
+    conv_rounds = int(os.environ.get("FEDTPU_CF_CONV_ROUNDS", "20"))
+    acc_tol = float(os.environ.get("FEDTPU_CF_ACC_TOL", "0.05"))
+
+    # ------------------------------------------------------------- sweep
+    model = zoo.create(model_name, num_classes=10)
+    shapes = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+    )["params"]
+    rng = np.random.default_rng(0)
+    deltas = jax.tree.map(
+        lambda s: rng.normal(scale=1e-2, size=s.shape).astype(np.float32),
+        shapes,
+    )
+    flat_ref = np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree_util.tree_leaves(deltas)]
+    )
+    ref_norm = float(np.linalg.norm(flat_ref)) or 1.0
+
+    def med(fn):
+        fn()  # warmup (allocator, BLAS thread pools)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1e3)
+        return round(sorted(times)[len(times) // 2], 3)
+
+    def rel_l2(tree):
+        got = np.concatenate(
+            [
+                np.asarray(l, np.float32).ravel()
+                for l in jax.tree_util.tree_leaves(tree)
+            ]
+        )
+        return round(float(np.linalg.norm(got - flat_ref)) / ref_norm, 6)
+
+    # collect_residual=False everywhere: the sweep measures the record a
+    # client ships, not the EF bookkeeping around it (randk then applies
+    # its unbiased total/k rescale — the no-EF wire semantics).
+    specs = [
+        ("dense", lambda: wire.encode(deltas)),
+        (
+            "int8",
+            lambda: sparse.encode_int8_flat(deltas, collect_residual=False)[0],
+        ),
+        (
+            "topk",
+            lambda: sparse.encode_topk_flat(
+                deltas, fraction, collect_residual=False
+            )[0],
+        ),
+    ]
+    for bits in sparse.ROTQ_BITS:
+        specs.append(
+            (
+                f"rotq@{bits}b",
+                lambda b=bits: sparse.encode_rotq_flat(
+                    deltas, bits=b, collect_residual=False, seed=7
+                )[0],
+            )
+        )
+    specs.append(
+        (
+            "randk",
+            lambda: sparse.encode_randk_flat(
+                deltas, fraction, collect_residual=False, seed=7
+            )[0],
+        )
+    )
+
+    dense_bytes = len(wire.encode(deltas))
+    sweep = {}
+    for name, enc in specs:
+        payload = enc()
+        if name == "dense":
+            decoded = wire.decode(payload, deltas)
+            dec = lambda p=payload: wire.decode(p, deltas)
+        else:
+            decoded = sparse.decode(payload, deltas)[0]
+            dec = lambda p=payload: sparse.decode(p, deltas)
+        sweep[name] = {
+            "wire_bytes": len(payload),
+            "reduction_x": round(dense_bytes / max(len(payload), 1), 3),
+            "encode_host_ms": med(enc),
+            "decode_host_ms": med(dec),
+            "rel_l2_error": rel_l2(decoded),
+        }
+    total = int(flat_ref.size)
+    pad_ratio = round(sparse._next_pow2(max(total, 1)) / max(total, 1), 4)
+
+    # ------------------------------------------------------- convergence
+    def conv_cfg(compression):
+        return RoundConfig(
+            model="mlp",
+            num_classes=10,
+            opt=OptimizerConfig(learning_rate=0.05, weight_decay=0.0),
+            data=DataConfig(
+                dataset="synthetic",
+                batch_size=8,
+                eval_batch_size=64,
+                num_examples=256,
+                augment=False,
+            ),
+            fed=FedConfig(
+                num_clients=conv_clients,
+                telemetry="off",
+                compression=compression,
+                topk_fraction=fraction,
+                error_feedback=True,
+                delta_layout="flat",
+            ),
+            steps_per_round=2,
+        )
+
+    test_x, test_y = load("synthetic", "test", num=512)
+    runs = {}
+    conv_delta = None
+    for name in ("none", "randk"):
+        fed = Federation(conv_cfg(name), seed=0)
+        init_params = jax.tree.map(np.asarray, fed.state.params)
+        fed.run(conv_rounds)
+        _, acc = fed.evaluate(test_x, test_y)
+        runs[name] = {"final_test_acc": round(float(acc), 4)}
+        if name == "randk":
+            conv_delta = {
+                "params": jax.tree.map(
+                    lambda a, b: np.asarray(a, np.float32) - b,
+                    fed.state.params,
+                    init_params,
+                )
+            }
+        del fed
+
+    # The per-round uplink: dense fleets ship the full payload, randk
+    # fleets ship the sparse record. Both sizes depend only on the model
+    # shape and the keep budget, so encoding the run's genuine aggregate
+    # delta once gives the exact per-round figure.
+    conv_dense_bytes = len(wire.encode(conv_delta))
+    conv_randk_bytes = len(
+        sparse.encode_randk_flat(
+            conv_delta["params"], fraction, collect_residual=False, seed=1
+        )[0]
+    )
+    reduction_x = round(conv_dense_bytes / max(conv_randk_bytes, 1), 3)
+    acc_gap = round(
+        abs(runs["none"]["final_test_acc"] - runs["randk"]["final_test_acc"]),
+        4,
+    )
+
+    result = {
+        "metric": "codec_frontier",
+        "unit": "x wire-byte reduction at the convergence operating point",
+        "value": reduction_x,
+        "gate_reduction_x": 10.0,
+        "gate_acc_tol": acc_tol,
+        "passes_gate": bool(reduction_x >= 10.0 and acc_gap <= acc_tol),
+        "sweep": {
+            "model": model_name,
+            "num_params": total,
+            "dense_bytes": dense_bytes,
+            "fraction": fraction,
+            "rotq_pad_ratio": pad_ratio,
+            "codecs": sweep,
+        },
+        "convergence": {
+            "model": "mlp",
+            "codec": "randk",
+            "fraction": fraction,
+            "error_feedback": True,
+            "clients": conv_clients,
+            "rounds": conv_rounds,
+            "runs": runs,
+            "acc_gap": acc_gap,
+            "bytes_up_dense": conv_dense_bytes,
+            "bytes_up_randk": conv_randk_bytes,
+            "reduction_x": reduction_x,
+        },
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    os.makedirs(ARTIFACTS_DIR, exist_ok=True)
+    path = os.path.join(ARTIFACTS_DIR, "CODEC_FRONTIER_MICROBENCH.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, path)
+    return result
+
+
 def _server_pipeline_microbench():
     """``server_pipeline_post_barrier``: barrier vs stream server collect.
 
@@ -2382,6 +2624,9 @@ def _print_diag(error: str) -> None:
 def main():
     if "--compression-microbench" in sys.argv:
         print(json.dumps(_compression_microbench()))
+        return
+    if "--codec-frontier-microbench" in sys.argv:
+        print(json.dumps(_codec_frontier_microbench()))
         return
     if "--server-pipeline-microbench" in sys.argv:
         print(json.dumps(_server_pipeline_microbench()))

@@ -108,6 +108,38 @@ def add_model_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def parse_compression(spec: str):
+    """Parse a ``--compression`` spec into ``(codec, rotq_bits | None)``.
+
+    Accepts a bare codec name or the parameterized ``rotq:bits=B`` form
+    (argparse ``type=`` hook, so a bad spec fails at parse time with a
+    usage error instead of deep inside config validation)."""
+    codec, _, rest = spec.partition(":")
+    bits = None
+    if rest:
+        if codec != "rotq" or not rest.startswith("bits="):
+            raise argparse.ArgumentTypeError(
+                f"bad compression spec {spec!r}: only rotq takes a "
+                "parameter, as rotq:bits=B"
+            )
+        try:
+            bits = int(rest[len("bits="):])
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"bad compression spec {spec!r}: bits must be an integer"
+            )
+        if bits not in (1, 2, 4, 8):
+            raise argparse.ArgumentTypeError(
+                f"rotq bits must be 1, 2, 4 or 8, got {bits}"
+            )
+    if codec not in ("none", "topk", "int8", "rotq", "randk"):
+        raise argparse.ArgumentTypeError(
+            f"unknown codec {codec!r}; have none | topk | int8 | "
+            "rotq[:bits=B] | randk"
+        )
+    return codec, bits
+
+
 def add_compression_flags(p: argparse.ArgumentParser) -> None:
     """Delta-codec flags, shared by the simulated engine CLI, the gRPC
     server AND the gRPC client (the client encodes its own wire payloads,
@@ -115,10 +147,24 @@ def add_compression_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--compression",
         default=None,
-        choices=["none", "topk", "int8"],
-        help="delta codec; default: topk when -c Y, none otherwise",
+        type=parse_compression,
+        help="delta codec: none | topk | int8 | rotq[:bits=B] | randk "
+        "(rotq/randk are the seeded flat sketch codecs, "
+        "docs/FLAT_DELTA.md §Codec matrix; B in {1,2,4,8}, default 4; "
+        "randk reuses --topk-fraction as its keep fraction); "
+        "default: topk when -c Y, none otherwise",
     )
     p.add_argument("--topk-fraction", default=0.01, type=float)
+    p.add_argument(
+        "--codec-policy",
+        default="static",
+        choices=["static", "adaptive"],
+        help="codec selection on the gRPC edge: static = every client uses "
+        "--compression every round; adaptive = the coordinator picks a "
+        "codec per client per round from observed bytes x RTT "
+        "(docs/OPERATIONS.md §Adaptive codec; requires --delta-layout "
+        "flat)",
+    )
     p.add_argument(
         "--delta-layout",
         default="per_leaf",
@@ -881,6 +927,9 @@ def make_flight_recorder(role: str, telemetry=None):
 def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfig:
     compress = str(getattr(args, "compressFlag", "N")).upper() == "Y"
     compression = getattr(args, "compression", None)
+    rotq_bits = None
+    if isinstance(compression, tuple):  # parse_compression (codec, bits)
+        compression, rotq_bits = compression
     if compression is None:
         compression = "topk" if compress else "none"
     shape, n_classes = dataset_info(args.dataset)
@@ -914,6 +963,8 @@ def build_config(args, num_clients: int, steps_per_round: int = 8) -> RoundConfi
             ),
             compression=compression,
             topk_fraction=getattr(args, "topk_fraction", 0.01),
+            rotq_bits=rotq_bits if rotq_bits is not None else 4,
+            codec_policy=getattr(args, "codec_policy", "static"),
             delta_layout=getattr(args, "delta_layout", "per_leaf"),
             server_pipeline=getattr(args, "server_pipeline", "auto"),
             aggregator=getattr(args, "aggregator", "mean"),

@@ -360,10 +360,25 @@ class FedConfig:
     # arXiv:2306.03240). Falls back to uniform until a loss is observed.
     participation_sampling: str = "uniform"  # uniform | loss
     # Compression of client deltas before aggregation (parity with -c Y,
-    # reference: src/server.py:104-107). none | topk | int8
+    # reference: src/server.py:104-107).
+    #   none | topk | int8, any delta_layout; plus the seeded sketch codecs
+    #   rotq (rotated b-bit quantization, rotq_bits below) and randk
+    #   (random-coordinate subsampling, reusing topk_fraction as the keep
+    #   fraction) — flat-layout only (docs/FLAT_DELTA.md §Codec matrix).
     compression: str = "none"
     topk_fraction: float = 0.01
     error_feedback: bool = True
+    # Bit width for compression='rotq' (1 | 2 | 4 | 8): wire cost is
+    # rotq_bits * pow2(P) / 8 bytes per client per round.
+    rotq_bits: int = 4
+    # Codec selection on the distributed edge (fedtpu.transport.federation):
+    #   "static": every client uses `compression` every round (the default).
+    #   "adaptive": the coordinator picks a codec per client per round from
+    #     {none, int8, topk, rotq, randk} by observed bytes x RTT
+    #     (fedtpu.transport.codec_policy.AdaptiveCodecPolicy), shipping the
+    #     choice in StartTrain. Requires delta_layout='flat' (the sketch
+    #     codecs only exist there). Engine-side federation ignores this.
+    codec_policy: str = "static"  # static | adaptive
     # HOW the per-client delta travels through compression/aggregation.
     #   "per_leaf": every codec stage + the FedAvg reduction run once per
     #     pytree leaf (the original path; the parity default).

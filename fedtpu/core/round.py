@@ -15,6 +15,7 @@ runs under ``shard_map`` with the vmap axis sharded and the mean becoming a
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import logging
 import math
 from typing import Any, Callable, NamedTuple, Optional, Tuple
@@ -546,6 +547,18 @@ def make_round_step(
             "have per_leaf | flat"
         )
     flat_mode = cfg.fed.delta_layout == "flat"
+    # Seeded codecs (rotq/randk) take the round index as their per-round
+    # seed, and rotq needs the power-of-two row padding for the Hadamard
+    # butterfly — both are static properties of the compressor, resolved
+    # once here so the traced body stays branch-free.
+    flat_pow2 = compressor is not None and getattr(
+        compressor, "pad_pow2", False
+    )
+    flat_takes_round = (
+        compressor is not None
+        and compressor.apply_flat is not None
+        and "round_idx" in inspect.signature(compressor.apply_flat).parameters
+    )
     if compressor is not None:
         comp_layout = getattr(compressor, "layout", "per_leaf")
         if flat_mode and compressor.apply_flat is None:
@@ -732,7 +745,7 @@ def make_round_step(
             # compression='none' and 'int8' bit-identical across layouts.
             from fedtpu.ops import flat as flat_ops
 
-            flat_layout = flat_ops.make_layout(state.params)
+            flat_layout = flat_ops.make_layout(state.params, pow2=flat_pow2)
             deltas = flat_ops.pack_stacked(flat_layout, deltas)
         # Model-level adversaries (fedtpu.sim.adversary): malicious seats
         # replace their honest delta with the attacked one BEFORE the codec
@@ -790,9 +803,15 @@ def make_round_step(
         comp_state = state.comp_state
         if compressor is not None:
             if flat_mode:
-                deltas, new_comp = compressor.apply_flat(
-                    deltas, comp_state, flat_layout
-                )
+                if flat_takes_round:
+                    deltas, new_comp = compressor.apply_flat(
+                        deltas, comp_state, flat_layout,
+                        round_idx=state.round_idx,
+                    )
+                else:
+                    deltas, new_comp = compressor.apply_flat(
+                        deltas, comp_state, flat_layout
+                    )
             else:
                 deltas, new_comp = compressor.apply(deltas, comp_state)
             # Clients contributing nothing this round (agg_w == 0: dead,

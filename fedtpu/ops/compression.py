@@ -16,6 +16,17 @@ it actually pays on TPU: client *deltas* are sparsified/quantized on-device
 Codecs:
 - ``topk``  — per-leaf, per-client magnitude top-k (fraction ``topk_fraction``).
 - ``int8``  — per-leaf, per-client symmetric int8 quantization.
+- ``rotq``  — flat-layout only: seeded structured random rotation
+  (subsampled randomized Hadamard transform, Konečný et al. 1610.05492)
+  followed by per-row uniform b-bit quantization with stochastic rounding;
+  the server inverse-rotates the dequantized row. Requires the
+  power-of-two row padding (``Compressor.pad_pow2``).
+- ``randk`` — flat-layout only: seeded random-coordinate subsampling.
+  With error feedback the kept coordinates ship unscaled (contractive; the
+  residual carries exactly the dropped mass); without it they are rescaled
+  by ``total/k`` so the estimator is unbiased. The per-round coordinate
+  set is one shared seeded draw, so the codec is deterministic and both
+  wire ends agree without shipping indices.
 
 Both run through the fused Pallas kernels in
 :mod:`fedtpu.ops.pallas_kernels`; both are simulated on-device (compress →
@@ -56,6 +67,14 @@ class Compressor(NamedTuple):
     per-leaf dispatches; residual state is then one ``[clients, P]`` buffer.
     ``apply`` still works on pytrees for flat codecs (it packs/unpacks
     internally), so standalone callers need not care about the layout.
+
+    ``pad_pow2`` marks codecs whose flat row must be padded to a power of
+    two (the Hadamard butterfly of ``rotq``): the round step and the
+    residual initialiser build their layouts with
+    ``make_layout(..., pow2=True)`` when it is set. Seeded codecs
+    (``rotq``/``randk``) additionally accept a ``round_idx`` keyword on
+    ``apply_flat`` — the per-round seed that keeps client and server (and
+    replays) drawing identical rotations/coordinate sets.
     """
 
     init: Callable[[Pytree, int], Pytree]
@@ -64,6 +83,7 @@ class Compressor(NamedTuple):
     apply_flat: Optional[
         Callable[[jnp.ndarray, Pytree, flat_ops.FlatLayout], Tuple[jnp.ndarray, Pytree]]
     ] = None
+    pad_pow2: bool = False
 
 
 def _flatten_leaf(d: jnp.ndarray) -> jnp.ndarray:
@@ -117,26 +137,30 @@ def _make_apply(
     return apply
 
 
-def _make_flat_init(error_feedback: bool) -> Callable[[Pytree, int], Pytree]:
+def _make_flat_init(
+    error_feedback: bool, pow2: bool = False
+) -> Callable[[Pytree, int], Pytree]:
     """Flat-layout residual initialiser: ONE ``[clients, P]`` buffer instead
     of a per-leaf pytree (or ``()`` when error feedback is off)."""
 
     def init(params: Pytree, num_clients: int) -> Pytree:
         if not error_feedback:
             return ()
-        lay = flat_ops.make_layout(params)
+        lay = flat_ops.make_layout(params, pow2=pow2)
         return jnp.zeros((num_clients, lay.padded), jnp.float32)
 
     return init
 
 
-def _lift_flat(apply_flat) -> Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]:
+def _lift_flat(
+    apply_flat, pow2: bool = False
+) -> Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]]:
     """Pytree-level ``apply`` for a flat codec: pack once, run the flat
     codec, unpack. Standalone-caller convenience — the round step packs its
     own buffer and calls ``apply_flat`` directly."""
 
     def apply(deltas: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
-        lay = flat_ops.make_layout_stacked(deltas)
+        lay = flat_ops.make_layout_stacked(deltas, pow2=pow2)
         out, new_state = apply_flat(
             flat_ops.pack_stacked(lay, deltas), state, lay
         )
@@ -193,6 +217,137 @@ def _make_int8_flat(error_feedback: bool) -> Compressor:
         layout="flat",
         apply_flat=apply_flat,
     )
+
+
+# Base seeds for the per-round PRNG streams of the seeded codecs. The
+# effective key is fold_in(PRNGKey(base), round_idx) — deterministic per
+# round, shared by every client in the engine, and distinct between the
+# rotation and subsampling codecs.
+_ROTQ_SEED = 0x5EED0    # noqa: E262 — rotation/uniform stream
+_RANDK_SEED = 0x5EED1   # coordinate-subsampling stream
+
+ROTQ_BIT_WIDTHS = (1, 2, 4, 8)
+
+
+def _make_rotq_flat(bits: int, error_feedback: bool) -> Compressor:
+    """Flat-layout rotated-sketch quantizer (rotq): rotate the padded row
+    through the seeded randomized Hadamard transform, uniform-quantize to
+    ``bits`` bits per coordinate with stochastic rounding over the per-row
+    [min, max] range, then inverse-rotate — so aggregation sees exactly the
+    values the wire record reconstructs.
+
+    Unbiasedness: stochastic rounding satisfies ``E[q] = z`` per rotated
+    coordinate conditionally on the (z-measurable) range, and both
+    rotations are linear, so ``E[out] = delta + residual`` — the property
+    ``tests/test_properties.py`` pins over seeds. The rotation spreads each
+    coordinate's energy across the row, so the per-row uniform grid costs
+    ~O(||y||/sqrt(h)) per coordinate instead of O(max|y|) (Konečný et al.).
+
+    Pad-clean rule: the rotated row legitimately mixes real coordinates
+    into the pad region, so the codec re-zeros ``[total:]`` AFTER the
+    inverse rotation. In exact math those coordinates are exactly zero
+    (the pad of ``y`` is zero and the transform pair is the identity);
+    only quantization noise lands there, and dropping it keeps the buffer
+    invariant without biasing the real coordinates.
+    """
+    if bits not in ROTQ_BIT_WIDTHS:
+        raise ValueError(
+            f"rotq bits must be one of {ROTQ_BIT_WIDTHS}, got {bits}"
+        )
+    levels = float(2**bits - 1)
+
+    def apply_flat(y, state, lay, round_idx=0):
+        if error_feedback:
+            y = y + state
+        h = lay.padded
+        if h & (h - 1):
+            raise ValueError(
+                f"rotq needs a power-of-two row (got padded={h}); build the "
+                "layout with make_layout(..., pow2=True)"
+            )
+        key = jax.random.fold_in(jax.random.PRNGKey(_ROTQ_SEED), round_idx)
+        k_sign, k_unif = jax.random.split(key)
+        signs = jax.random.rademacher(k_sign, (h,), jnp.float32)
+        z = pk.hadamard_rotate(y, signs)
+        lo = jnp.min(z, axis=1, keepdims=True)
+        scale = (jnp.max(z, axis=1, keepdims=True) - lo) / levels
+        safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+        u = jax.random.uniform(k_unif, z.shape, jnp.float32)
+        q = jnp.clip(jnp.floor((z - lo) / safe + u), 0.0, levels)
+        out = pk.hadamard_rotate(lo + q * safe, signs, inverse=True)
+        if lay.pad:
+            out = jnp.concatenate(
+                [out[:, : lay.total], jnp.zeros_like(out[:, lay.total :])],
+                axis=1,
+            )
+        if not error_feedback:
+            return out, state
+        return out, y - out
+
+    return Compressor(
+        init=_make_flat_init(error_feedback, pow2=True),
+        apply=_lift_flat(apply_flat, pow2=True),
+        layout="flat",
+        apply_flat=apply_flat,
+        pad_pow2=True,
+    )
+
+
+def _make_randk_flat(fraction: float, error_feedback: bool) -> Compressor:
+    """Flat-layout random-k subsampling (randk): one shared seeded draw of
+    ``k = ceil(fraction * total)`` real coordinates per round; every client
+    ships exactly those.
+
+    The EF rescale rule (documented in docs/FLAT_DELTA.md, pinned by
+    ``tests/test_properties.py``): with error feedback OFF the kept values
+    are rescaled by ``total/k`` so the estimator is unbiased
+    (``E[out] = y`` over the uniform coordinate draw). With error feedback
+    ON the rescale is dropped — the residual then carries exactly the
+    dropped mass (``out + residual == y``), which keeps the compression
+    operator contractive; a rescaled-and-fed-back variant would inject the
+    (total/k - 1)-amplified kept mass into the residual and diverge.
+    """
+
+    def apply_flat(y, state, lay, round_idx=0):
+        if error_feedback:
+            y = y + state
+        k = max(1, int(math.ceil(fraction * lay.total)))
+        if k >= lay.total:  # keep-all budget
+            return y, (jnp.zeros_like(y) if error_feedback else state)
+        key = jax.random.fold_in(jax.random.PRNGKey(_RANDK_SEED), round_idx)
+        idx = jax.random.choice(key, lay.total, (k,), replace=False)
+        mask = jnp.zeros((lay.padded,), jnp.float32).at[idx].set(1.0)
+        kept = y * mask[None, :]
+        if error_feedback:
+            return kept, y - kept
+        return kept * jnp.float32(lay.total / k), state
+
+    return Compressor(
+        init=_make_flat_init(error_feedback),
+        apply=_lift_flat(apply_flat),
+        layout="flat",
+        apply_flat=apply_flat,
+    )
+
+
+def make_rotq(
+    bits: int = 4, error_feedback: bool = True, layout: str = "flat"
+) -> Compressor:
+    """Rotated-sketch quantizer — flat layout only (the rotation is over
+    the whole concatenated update by construction)."""
+    if layout != "flat":
+        raise ValueError("rotq is a flat-layout codec; set delta_layout='flat'")
+    return _make_rotq_flat(bits, error_feedback)
+
+
+def make_randk(
+    fraction: float, error_feedback: bool = True, layout: str = "flat"
+) -> Compressor:
+    """Random-k coordinate subsampling — flat layout only (the coordinate
+    draw is over the whole concatenated update by construction)."""
+    if layout != "flat":
+        raise ValueError("randk is a flat-layout codec; set delta_layout='flat'")
+    return _make_randk_flat(fraction, error_feedback)
 
 
 def make_topk(
@@ -280,6 +435,16 @@ def make_compressor(fed: FedConfig) -> Optional[Compressor]:
         )
     if fed.compression == "int8":
         return make_int8(fed.error_feedback, layout=fed.delta_layout)
+    if fed.compression == "rotq":
+        return make_rotq(
+            fed.rotq_bits, fed.error_feedback, layout=fed.delta_layout
+        )
+    if fed.compression == "randk":
+        # randk shares the top-k keep-fraction knob: both answer "what
+        # fraction of coordinates ship this round".
+        return make_randk(
+            fed.topk_fraction, fed.error_feedback, layout=fed.delta_layout
+        )
     raise ValueError(f"unknown compression '{fed.compression}'")
 
 

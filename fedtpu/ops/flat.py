@@ -78,14 +78,29 @@ class FlatLayout(NamedTuple):
         return self.padded - self.total
 
 
-def _padded(total: int, lane: int) -> int:
-    return max(lane, int(math.ceil(max(total, 1) / lane)) * lane)
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1)."""
+    return 1 << max(n - 1, 0).bit_length()
 
 
-def make_layout(template: Pytree, lane: int = LANE) -> FlatLayout:
+def _padded(total: int, lane: int, pow2: bool = False) -> int:
+    lane_padded = max(lane, int(math.ceil(max(total, 1) / lane)) * lane)
+    if not pow2:
+        return lane_padded
+    # Power-of-two padding (rotated-sketch codecs): the Hadamard butterfly
+    # needs the row length to be 2^m. Every pow2 >= LANE is lane-aligned,
+    # so the Mosaic tiling rule still holds.
+    return next_pow2(lane_padded)
+
+
+def make_layout(
+    template: Pytree, lane: int = LANE, pow2: bool = False
+) -> FlatLayout:
     """Layout from a (single, unstacked) params-shaped pytree. Works on
     concrete arrays and on ``jax.eval_shape`` results alike — only shapes
-    and dtypes are read."""
+    and dtypes are read. ``pow2=True`` pads the row to the next power of
+    two instead of the next lane multiple (still lane-aligned), which is
+    what the rotated-sketch codecs need for the Hadamard transform."""
     leaves, treedef = jax.tree_util.tree_flatten(template)
     shapes = tuple(tuple(int(d) for d in np.shape(l)) for l in leaves)
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
@@ -98,17 +113,19 @@ def make_layout(template: Pytree, lane: int = LANE) -> FlatLayout:
         offsets=offsets,
         sizes=sizes,
         total=total,
-        padded=_padded(total, lane),
+        padded=_padded(total, lane, pow2),
     )
 
 
-def make_layout_stacked(stacked: Pytree, lane: int = LANE) -> FlatLayout:
+def make_layout_stacked(
+    stacked: Pytree, lane: int = LANE, pow2: bool = False
+) -> FlatLayout:
     """Layout from a ``[clients, ...]``-stacked delta pytree (the leading
     axis is dropped from every leaf shape)."""
     single = jax.tree.map(
         lambda l: jax.ShapeDtypeStruct(tuple(l.shape[1:]), l.dtype), stacked
     )
-    return make_layout(single, lane)
+    return make_layout(single, lane, pow2)
 
 
 def segment_ids(layout: FlatLayout) -> np.ndarray:

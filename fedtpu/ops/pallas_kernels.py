@@ -39,6 +39,7 @@ check, ``tools/compile_pallas_tpu.py``).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -137,6 +138,86 @@ def threshold_with_feedback(
         ],
         interpret=mode == "interpret",
     )(y, thresh.reshape(rows, 1))
+
+
+def _fwht_body(x: jnp.ndarray) -> jnp.ndarray:
+    """Unnormalized fast Walsh–Hadamard transform over the last axis.
+
+    Iterative stride-doubling butterfly: at step ``s`` the row is viewed as
+    ``[pairs, 2, s]`` blocks and each (a, b) pair maps to (a+b, a-b) —
+    log2(h) passes, each a reshape plus one add/sub, which XLA fuses into a
+    handful of elementwise programs. ``h`` must be a power of two (the
+    ``pow2=True`` flat layout guarantees it). H is symmetric and
+    ``H @ H == h * I``, so the same body normalized by ``1/sqrt(h)`` is its
+    own inverse — the property the rotq codec's decode side relies on.
+    """
+    rows, h = x.shape
+    step = 1
+    while step < h:
+        x = x.reshape(rows, h // (2 * step), 2, step)
+        a = x[:, :, 0, :]
+        b = x[:, :, 1, :]
+        x = jnp.stack([a + b, a - b], axis=2).reshape(rows, h)
+        step *= 2
+    return x
+
+
+def _hadamard_kernel(x_ref, out_ref):
+    """One row-block of the full-width FWHT butterfly.
+
+    Unlike the elementwise kernels above, the transform MIXES every column
+    of a row, so the grid tiles rows only and each step reads the whole
+    ``[rb, h]`` row block — which bounds the Mosaic-compilable ``h`` by
+    VMEM (~16 MB / (2 operands x rb x 4 B) ≈ 256K f32 columns at rb=8).
+    Beyond that the plain-XLA path below is the production default anyway
+    (same measured-verdict story as the other kernels in this file).
+    """
+    out_ref[...] = _fwht_body(x_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("inverse", "interpret"))
+def hadamard_rotate(
+    y: jnp.ndarray,
+    signs: jnp.ndarray,
+    inverse: bool = False,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Seeded structured random rotation ``R = (1/sqrt(h)) * H * D``.
+
+    ``y: [rows, h]`` with ``h`` a power of two; ``signs: [h]`` the
+    Rademacher diagonal D. Forward: ``R y = fwht(y * signs) / sqrt(h)``;
+    ``inverse=True`` computes ``R^-1 y = fwht(y) / sqrt(h) * signs``
+    (exact, because ``fwht(fwht(x)) == h * x``). The rotq codec rotates on
+    the client, quantizes, and inverse-rotates on the server — both ends
+    regenerate ``signs`` from the shared record seed.
+
+    Parity: the interpreted pallas_call body is pinned against this
+    function's own plain-jnp (lax) branch by ``tests/test_compression.py``.
+    """
+    rows, h = y.shape
+    if h & (h - 1):
+        raise ValueError(f"hadamard_rotate needs a power-of-two width, got {h}")
+    y = y.astype(jnp.float32)
+    signs = signs.astype(jnp.float32)
+    norm = jnp.float32(1.0 / math.sqrt(h))
+    if not inverse:
+        y = y * signs[None, :]
+    mode = _mode(interpret)
+    if mode == "xla":
+        out = _fwht_body(y) * norm
+    else:
+        rb = rows if rows <= _BLOCK_ROWS else _BLOCK_ROWS
+        out = pl.pallas_call(
+            _hadamard_kernel,
+            grid=(pl.cdiv(rows, rb),),
+            in_specs=[pl.BlockSpec((rb, h), lambda r: (r, 0))],
+            out_specs=pl.BlockSpec((rb, h), lambda r: (r, 0)),
+            out_shape=jax.ShapeDtypeStruct(y.shape, jnp.float32),
+            interpret=mode == "interpret",
+        )(y) * norm
+    if inverse:
+        out = out * signs[None, :]
+    return out
 
 
 def _quantdequant_kernel(x_ref, s_ref, out_ref):

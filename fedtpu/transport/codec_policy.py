@@ -1,0 +1,108 @@
+"""Telemetry-driven adaptive codec selection for the distributed edge.
+
+The codec frontier (docs/PERF_ANALYSIS.md §Communication-efficiency
+frontier) is per-client: a client behind a fast local link is cheapest
+uncompressed (no encode latency, no quantization noise), one behind a
+congested WAN hop wants the smallest record that still converges. The
+static ``FedConfig.compression`` knob picks ONE point for the whole
+federation; :class:`AdaptiveCodecPolicy` instead picks per client per
+round from the observed *cost* of each codec on that client's actual
+link.
+
+Cost model: ``bytes_up x RTT`` — the two measurements the server already
+has for every StartTrain (the ``fedtpu_rpc_bytes_up_total`` counter input
+and the ``fedtpu_client_rpc_seconds`` sample). Bytes alone would always
+pick the smallest codec (ignoring that a fast link makes compression
+pointless); RTT alone is noisy under scheduling jitter. Their product is
+the bandwidth-delay-style figure the frontier trades on, smoothed per
+(client, codec) with an EWMA.
+
+Selection is deterministic given the observation history (no RNG): during
+WARMUP each client cycles through the candidate list in order until every
+codec has at least one observation; after that, argmin EWMA cost with
+candidate order breaking ties. The choice ships to the client in
+``TrainRequest.codec`` (additive proto field 5); a legacy client skips the
+unknown field and keeps its static codec — the policy then simply keeps
+observing whatever codec the replies actually used.
+
+Error-feedback safety across switches is the CLIENT's job (the
+rescale-or-reset rule in ``fedtpu.transport.federation.ClientAgent``):
+the dense model-space residual is codec-agnostic, so lossy->lossy
+switches carry it unchanged, and a switch to 'none' flushes it into the
+dense payload. The policy never needs to know.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+# Candidate order is also the warmup probe order and the tiebreak order:
+# cheapest-to-encode first, so the first rounds of a federation pay the
+# least encode latency while the policy is still blind.
+DEFAULT_CANDIDATES: Tuple[str, ...] = ("none", "int8", "topk", "rotq", "randk")
+
+# EWMA smoothing for the per-(client, codec) cost. 0.3 ~ a 3-round memory:
+# fast enough to chase a link-quality change within a few rounds, slow
+# enough that one stalled RPC doesn't exile a codec.
+_ALPHA = 0.3
+
+
+class AdaptiveCodecPolicy:
+    """Per-client codec chooser over EWMA(bytes_up x RTT) observations.
+
+    Thread-safe: ``observe`` runs on the server's collect workers while
+    ``choose`` runs on the round thread.
+    """
+
+    def __init__(self, candidates: Sequence[str] = DEFAULT_CANDIDATES):
+        if not candidates:
+            raise ValueError("adaptive codec policy needs >= 1 candidate")
+        self.candidates: Tuple[str, ...] = tuple(candidates)
+        # rank -> codec -> (ewma_cost, observation_count)
+        self._stats: Dict[int, Dict[str, Tuple[float, int]]] = {}
+        self._lock = threading.Lock()
+
+    def observe(
+        self, rank: int, codec: str, bytes_up: int, rtt_s: float
+    ) -> None:
+        """Fold one completed StartTrain into the client's cost table.
+
+        ``codec`` is the codec the reply ACTUALLY used (the decode-side
+        ``_codec`` tag), not the one requested — a legacy client that
+        ignored the request still teaches the policy about its static
+        codec rather than poisoning another codec's estimate.
+        """
+        if codec not in self.candidates:
+            return
+        # Floor the RTT so a clock hiccup reporting ~0 cannot make a codec
+        # look free; bytes_up >= header size keeps the product positive.
+        cost = float(max(bytes_up, 1)) * max(float(rtt_s), 1e-4)
+        with self._lock:
+            per = self._stats.setdefault(rank, {})
+            old, n = per.get(codec, (cost, 0))
+            per[codec] = (old + _ALPHA * (cost - old), n + 1)
+
+    def choose(self, rank: int) -> Optional[str]:
+        """The codec this client should use next round, or the first
+        unobserved candidate while warming up. Deterministic in the
+        observation history."""
+        with self._lock:
+            per = self._stats.get(rank, {})
+            for c in self.candidates:
+                if per.get(c, (0.0, 0))[1] == 0:
+                    return c
+            return min(
+                self.candidates, key=lambda c: (per[c][0], self.candidates.index(c))
+            )
+
+    def snapshot(self) -> Dict[str, Dict[str, dict]]:
+        """Cost table for /statusz: rank -> codec -> {cost, n, chosen}."""
+        with self._lock:
+            out: Dict[str, Dict[str, dict]] = {}
+            for rank, per in sorted(self._stats.items()):
+                out[str(rank)] = {
+                    c: {"ewma_cost": cost, "observations": n}
+                    for c, (cost, n) in sorted(per.items())
+                }
+            return out

@@ -68,6 +68,7 @@ from fedtpu.obs import (
 from fedtpu.obs import propagate
 from fedtpu.obs.registry import Counter
 from fedtpu.transport import proto, sparse, wire
+from fedtpu.transport.codec_policy import AdaptiveCodecPolicy
 from fedtpu.transport.retry import call_with_retry, is_stale_coordinator
 from fedtpu.transport.service import (
     TrainerServicer,
@@ -99,6 +100,28 @@ def _payload_template(model, cfg: RoundConfig):
         "batch_stats": stats,
         "num_examples": np.zeros((), np.float32),
     }
+
+
+# FSP1 record kind -> codec name, for the per-codec wire accounting
+# (fedtpu_rpc_bytes_*_total{codec=...} and the /statusz byte table). Dense
+# FTP1 payloads carry no kind and count as "none".
+_CODEC_OF_KIND = {
+    "topk": "topk",
+    "topk_flat": "topk",
+    "int8": "int8",
+    "int8_flat": "int8",
+    "rotq_flat": "rotq",
+    "randk_flat": "randk",
+    "partial_flat": "partial",
+}
+
+
+def _sum_codec_bytes(pairs) -> Dict[str, int]:
+    """Fold (codec_name, nbytes) pairs into a {codec: total_bytes} dict."""
+    out: Dict[str, int] = {}
+    for codec_name, nb in pairs:
+        out[codec_name] = out.get(codec_name, 0) + int(nb)
+    return out
 
 
 # --------------------------------------------------------------------- client
@@ -330,7 +353,8 @@ class LocalTrainer:
 
     def train_round(self, rank: int, world: int,
                     trace_ctx: Optional[propagate.TraceContext] = None,
-                    coord_round: int = -1) -> bytes:
+                    coord_round: int = -1,
+                    codec_override: Optional[str] = None) -> bytes:
         """One local epoch on this client's shard; returns the wire payload
         (trained weights + stats + example count). ``trace_ctx`` — the
         coordinator's propagated trace context, when the StartTrain carried
@@ -342,12 +366,16 @@ class LocalTrainer:
         peers): a value BEHIND this client's local counter means the
         coordinator recovered from a checkpoint older than the rounds this
         client already trained, and the local state rolls back to match
-        (see _train_round_impl)."""
+        (see _train_round_impl). ``codec_override`` — the coordinator's
+        per-round codec choice from ``TrainRequest.codec`` (the adaptive
+        policy); None keeps the static configured codec."""
         tel = self.telemetry
         propagate.adopt(tel.tracer, trace_ctx)
         with tel.span("client_train", rank=rank, round=self.round_idx,
                       **propagate.span_args(trace_ctx)):
-            payload = self._train_round_impl(rank, world, coord_round)
+            payload = self._train_round_impl(
+                rank, world, coord_round, codec_override
+            )
         self._persist_client_state()
         tel.counter(
             "fedtpu_client_tx_bytes_total",
@@ -360,7 +388,8 @@ class LocalTrainer:
         return payload
 
     def _train_round_impl(self, rank: int, world: int,
-                          coord_round: int = -1) -> bytes:
+                          coord_round: int = -1,
+                          codec_override: Optional[str] = None) -> bytes:
         cfg = self.cfg
         # Coordinator-replay rollback (disaster recovery): a StartTrain
         # whose lineage round is BEHIND our local counter means the
@@ -450,8 +479,12 @@ class LocalTrainer:
             )
             send_params, send_stats = sent["params"], sent["batch_stats"]
 
-        codec = cfg.fed.compression
-        if codec in ("topk", "int8") and self.synced:
+        # Per-round codec: the coordinator's adaptive choice when the
+        # StartTrain carried one (TrainRequest.codec), else the static
+        # configured codec — a legacy coordinator never sends the field and
+        # nothing changes.
+        codec = codec_override or cfg.fed.compression
+        if codec in ("topk", "int8", "rotq", "randk") and self.synced:
             # Ship the sparse/quantized *delta* — the wire actually shrinks,
             # unlike the reference's gzip-over-dense (src/server.py:104-107).
             delta = jax.tree.map(
@@ -465,21 +498,41 @@ class LocalTrainer:
             # or int8 block + offsets table) instead of a per-leaf map —
             # the wire twin of the engine's flat pipeline. The server's
             # template-based sparse.decode dispatches on the record kind,
-            # so mixed fleets decode either form.
+            # so mixed fleets decode either form. The seeded sketch codecs
+            # (rotq / randk) are inherently flat records — there is no
+            # per-leaf variant.
             if cfg.fed.delta_layout == "flat":
                 enc_topk, enc_int8 = sparse.encode_topk_flat, sparse.encode_int8_flat
             else:
                 enc_topk, enc_int8 = sparse.encode_topk, sparse.encode_int8
-            encode = (
-                (lambda d, r: enc_topk(
+            # Seeded codecs: the record seed is a pure function of (round,
+            # rank) so a replayed round re-encodes byte-identically (the
+            # coordinator-replay recovery path, and the bit-identical-replay
+            # pins in tests/test_properties.py) while distinct clients draw
+            # decorrelated rotations/index sets. atk_round is the
+            # round-START counter captured above.
+            sketch_seed = (atk_round << 16) | (rank & 0xFFFF)
+            if codec == "topk":
+                encode = lambda d, r: enc_topk(
                     d, cfg.fed.topk_fraction, residuals=r, extra=extra,
-                    collect_residual=ef))
-                if codec == "topk"
-                else (lambda d, r: enc_int8(
-                    d, residuals=r, extra=extra, collect_residual=ef))
-            )
+                    collect_residual=ef)
+            elif codec == "int8":
+                encode = lambda d, r: enc_int8(
+                    d, residuals=r, extra=extra, collect_residual=ef)
+            elif codec == "rotq":
+                encode = lambda d, r: sparse.encode_rotq_flat(
+                    d, bits=cfg.fed.rotq_bits, residuals=r, extra=extra,
+                    collect_residual=ef, seed=sketch_seed)
+            else:  # randk
+                encode = lambda d, r: sparse.encode_randk_flat(
+                    d, cfg.fed.topk_fraction, residuals=r, extra=extra,
+                    collect_residual=ef, seed=sketch_seed)
             payload, residual = encode(delta, self.edge_residual if ef else None)
             if ef:
+                # The residual is a dense model-space tree, so it carries
+                # UNCHANGED across adaptive lossy->lossy codec switches —
+                # no rescale needed (the rescale-or-reset rule,
+                # docs/OPERATIONS.md §Adaptive codec).
                 self.edge_residual = residual
             return payload
 
@@ -488,6 +541,31 @@ class LocalTrainer:
             "batch_stats": send_stats,
             "num_examples": np.float32(num_examples),
         }
+        if (
+            self.edge_residual is not None
+            and self.synced
+            and cfg.fed.error_feedback
+        ):
+            # The other half of the rescale-or-reset rule: switching to the
+            # dense codec FLUSHES the accumulated error-feedback residual
+            # into this round's full-weight payload (weights + residual ==
+            # what the lossy stream would eventually have delivered), then
+            # resets it — dropped mass is never silently lost across a
+            # switch to 'none'.
+            res = self.edge_residual
+            payload["params"] = jax.tree.map(
+                lambda w, r: (np.asarray(w) + np.asarray(r)).astype(
+                    np.asarray(w).dtype
+                ),
+                payload["params"], res["params"],
+            )
+            payload["batch_stats"] = jax.tree.map(
+                lambda w, r: (np.asarray(w) + np.asarray(r)).astype(
+                    np.asarray(w).dtype
+                ),
+                payload["batch_stats"], res["batch_stats"],
+            )
+            self.edge_residual = None
         return wire.encode(payload, compress=codec != "none")
 
     def set_global(self, data: bytes,
@@ -567,6 +645,10 @@ class ClientAgent(TrainerServicer):
             request.rank, request.world,
             trace_ctx=trace_context_of(context),
             coord_round=request.round,
+            # Adaptive-codec choice (field 5): 0/unknown ids fall back to
+            # the static configured codec, so an unrecognized id from a
+            # newer coordinator degrades safely instead of crashing.
+            codec_override=proto.CODEC_NAMES.get(request.codec),
         )
         return proto.TrainReply(message=payload)
 
@@ -774,6 +856,42 @@ class PrimaryServer:
                     "are released unclipped. Pick a model without "
                     "batch_stats (e.g. mlp)."
                 )
+        if cfg.fed.compression not in ("none", "topk", "int8", "rotq", "randk"):
+            raise ValueError(
+                f"unknown compression {cfg.fed.compression!r}; "
+                "have none | topk | int8 | rotq | randk"
+            )
+        if cfg.fed.codec_policy not in ("static", "adaptive"):
+            raise ValueError(
+                f"unknown codec_policy {cfg.fed.codec_policy!r}; "
+                "have static | adaptive"
+            )
+        # Adaptive codec selection (docs/OPERATIONS.md §Adaptive codec): the
+        # round loop ships a per-client codec choice in TrainRequest.codec,
+        # learned from observed bytes x RTT. Lossy codecs may be chosen any
+        # round, so the combination must satisfy the same constraints a
+        # static lossy codec would.
+        self._codec_policy: Optional[AdaptiveCodecPolicy] = None
+        if cfg.fed.codec_policy == "adaptive":
+            if cfg.fed.delta_layout != "flat":
+                raise ValueError(
+                    "codec_policy='adaptive' requires delta_layout='flat': "
+                    "the sketch codecs it selects among (rotq/randk) only "
+                    "exist as flat records"
+                )
+            if cfg.fed.aggregator != "mean" or cfg.fed.dp_clip_norm > 0:
+                raise ValueError(
+                    "codec_policy='adaptive' can select lossy codecs, so it "
+                    "needs aggregator='mean' and no DP clipping (the same "
+                    "constraints as a static lossy codec)"
+                )
+            self._codec_policy = AdaptiveCodecPolicy()
+        # Cumulative per-codec wire-byte ledger for /statusz (the labeled
+        # twins of the unlabeled rpc byte counters; kinds map to codec
+        # names via _CODEC_OF_KIND). Guarded by its own lock: collect
+        # workers write while /statusz reads.
+        self._codec_bytes_up: Dict[str, int] = {}
+        self._codec_bytes_lock = threading.Lock()
         self._server_opt = server_opt_lib.make_server_optimizer(cfg.fed)
         self._server_opt_state = server_opt_lib.init(cfg.fed, self.params)
         # Monotonic count of aggregations performed across this model
@@ -1750,12 +1868,21 @@ class PrimaryServer:
                 k: last[k]
                 for k in (
                     "participants", "stragglers", "bytes_up", "bytes_down",
+                    "bytes_up_by_codec",
                     "t_collect_s", "t_decode_s", "t_h2d_s", "t_aggregate_s",
                     "t_post_barrier_s", "t_round_s", "pipeline",
                     "client_latency",
                 )
                 if k in last
             }
+        # Per-codec wire-byte table (cumulative across rounds) and, under
+        # the adaptive policy, the live per-client cost table (docs/
+        # OPERATIONS.md §Adaptive codec).
+        with self._codec_bytes_lock:
+            if self._codec_bytes_up:
+                snap["codec_bytes_up"] = dict(self._codec_bytes_up)
+        if self._codec_policy is not None:
+            snap["codec_policy"] = self._codec_policy.snapshot()
         if self.compile_watcher is not None:
             snap["compile"] = self.compile_watcher.snapshot()
         return snap
@@ -1802,6 +1929,15 @@ class PrimaryServer:
             # event and counter inside _round_body; it is NOT a completed
             # round (the counter below would lie to dashboards).
             return rec
+        # Cumulative per-codec byte ledger for /statusz — independent of
+        # the telemetry mode (the round record is API either way).
+        by_codec = rec.get("bytes_up_by_codec", {})
+        if by_codec:
+            with self._codec_bytes_lock:
+                for codec_name, nb in by_codec.items():
+                    self._codec_bytes_up[codec_name] = (
+                        self._codec_bytes_up.get(codec_name, 0) + nb
+                    )
         self.flight.record(
             "round",
             round=self._round_counter - 1,
@@ -1823,6 +1959,15 @@ class PrimaryServer:
                 "fedtpu_rpc_bytes_down_total",
                 "server -> client/backup broadcast bytes (successful)",
             ).inc(rec["bytes_down"])
+            # Per-codec twins of the unlabeled byte counter above (the
+            # unlabeled series stays the authoritative total — dashboards
+            # and tests pin it — the labeled series adds the breakdown).
+            for codec_name, nb in rec.get("bytes_up_by_codec", {}).items():
+                tel.counter(
+                    "fedtpu_rpc_bytes_up_total",
+                    "client -> server StartTrain reply bytes (successful)",
+                    labels={"codec": codec_name},
+                ).inc(nb)
             tel.counter(
                 "fedtpu_stragglers_total",
                 "client-rounds lost to stragglers (deadline, in-flight)",
@@ -1940,6 +2085,13 @@ class PrimaryServer:
         # telemetry mode).
         bytes_up = Counter()  # client -> server payload bytes this round
         bytes_down = Counter()  # only successful sends count
+        # Per-codec wire accounting (docs/OBSERVABILITY.md §Codec bytes):
+        # which codec each surviving reply ACTUALLY used (the decode-side
+        # `_codec` record tag; dense payloads count as 'none') and its
+        # payload bytes. Single-key writes per collect worker (the
+        # `results` pattern); feeds the labeled rpc byte counters, the
+        # /statusz per-codec table and the adaptive policy's observations.
+        codec_of: Dict[str, tuple] = {}  # client -> (codec_name, bytes)
         # Tier mode: total leaf clients behind this round's partials (each
         # SubmitPartialReply reports its cohort's contributor count) — the
         # round record's participants stay the DIRECT peers (aggregators).
@@ -1970,6 +2122,14 @@ class PrimaryServer:
             # this round's span EXPLICITLY (thread-local nesting cannot
             # cross threads); decode/h2d spans below nest under it via the
             # worker's own stack.
+            # Adaptive codec: ONE choice per client per round, made before
+            # the attempt so retries re-request the same codec (a retried
+            # reply must match its observation).
+            codec_req = (
+                self._codec_policy.choose(rank)
+                if self._codec_policy is not None else None
+            )
+
             def attempt():
                 # One full RPC attempt INCLUDING reply decode: a payload
                 # that fails the wire CRC (corrupted in flight) raises
@@ -1998,6 +2158,7 @@ class PrimaryServer:
                         proto.TrainRequest(
                             rank=rank, world=world, round=lineage_round,
                             epoch=self._coord_epoch,
+                            codec=proto.CODEC_IDS.get(codec_req, 0),
                         ),
                         timeout=self._deadlines["StartTrain"],
                     )
@@ -2025,6 +2186,7 @@ class PrimaryServer:
                                 row,
                             )
                     t1 = time.monotonic()
+                    kind = extra.pop("_codec", None)
                     # Ship the row NOW: the transfer (and the in-place
                     # device-buffer write) overlaps the remaining
                     # clients' network wait instead of queueing behind
@@ -2059,6 +2221,7 @@ class PrimaryServer:
                             data, delta_template()
                         )
                     decode_s.inc(time.monotonic() - t0)
+                    kind = extra.pop("_codec", None)
                     out = (deltas, float(extra["num_examples"]))
                 else:
                     t0 = time.monotonic()
@@ -2076,9 +2239,11 @@ class PrimaryServer:
                             global_host(),
                         )
                     decode_s.inc(time.monotonic() - t0)
+                    kind = None  # dense full-weight payload
                     out = (delta, float(tree["num_examples"]))
                 # Count only the attempt that survived decode.
                 bytes_up.inc(len(data))
+                codec_of[client] = (_CODEC_OF_KIND.get(kind, "none"), len(data))
                 return out
 
             rpc_name = "SubmitPartial" if tiered else "StartTrain"
@@ -2097,6 +2262,14 @@ class PrimaryServer:
                     "per-client StartTrain wall time (RPC + decode, "
                     "retries included; successful rounds only)",
                 ).observe(latencies[client])
+                if self._codec_policy is not None and client in codec_of:
+                    # Teach the policy the codec the reply ACTUALLY used
+                    # (a legacy client ignoring the request still updates
+                    # the right codec's estimate).
+                    used, nbytes = codec_of[client]
+                    self._codec_policy.observe(
+                        rank, used, nbytes, latencies[client]
+                    )
             except (grpc.RpcError, wire.WireError) as e:
                 if is_stale_coordinator(e):
                     # The peer has seen a higher coordinator epoch: WE are
@@ -2627,6 +2800,11 @@ class PrimaryServer:
             "bytes_up": int(bytes_up.value),
             "bytes_down": int(bytes_down.value),
             "pipeline": self.server_pipeline,
+            # Per-codec breakdown of bytes_up (successful replies only;
+            # codec = what the record actually was, 'none' = dense).
+            "bytes_up_by_codec": _sum_codec_bytes(
+                codec_of[c] for c in completed if c in codec_of
+            ),
             # Phase timing: collect is launch->last join; decode/h2d are
             # summed per-client (overlapped with network wait under
             # "stream", so they can exceed nothing of the wall clock);

@@ -144,6 +144,14 @@ class TrainRequest:
     # (docs/FAULT_TOLERANCE.md §Fencing). Same +1 omit-zero trick as
     # ``round``: epoch 0 stays distinguishable from "absent".
     epoch: int = -1
+    # Additive field 5: the coordinator's per-round CODEC CHOICE for this
+    # client (the adaptive codec policy, docs/OPERATIONS.md §Adaptive
+    # codec). 0 = unset — the client keeps its static configured codec, and
+    # proto3 omit-zero means the field costs zero wire bytes in that (the
+    # common) case; legacy peers skip the unknown field and likewise keep
+    # their static codec. Nonzero values name a codec via
+    # CODEC_IDS/CODEC_NAMES below.
+    codec: int = 0
 
     def encode(self) -> bytes:
         return _encode_fields([
@@ -151,6 +159,7 @@ class TrainRequest:
             (2, _VARINT, self.world),
             (3, _VARINT, self.round + 1),
             (4, _VARINT, self.epoch + 1),
+            (5, _VARINT, self.codec),
         ])
 
     @classmethod
@@ -161,7 +170,14 @@ class TrainRequest:
             world=_int32(f.get(2, 0)),
             round=_int32(f.get(3, 0)) - 1,
             epoch=_int32(f.get(4, 0)) - 1,
+            codec=_int32(f.get(5, 0)),
         )
+
+
+# TrainRequest.codec wire ids (0 = unset/static). An enum by convention —
+# kept as module constants so the hand-rolled codec stays dataclass-plain.
+CODEC_IDS = {"none": 1, "int8": 2, "topk": 3, "rotq": 4, "randk": 5}
+CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 
 
 @dataclasses.dataclass

@@ -31,6 +31,27 @@ Hierarchical fan-in adds a fifth kind, ``partial_flat``
 aggregator's pre-weighted SUM of its cohort's flat delta rows plus the
 summed combine weight (``extra['weight_sum']``) — the payload of the
 ``SubmitPartial`` RPC (docs/FLAT_DELTA.md §FSP1 record kinds).
+
+The sketched-update codecs add two more kinds (docs/FLAT_DELTA.md §Codec
+matrix):
+
+- ``rotq_flat`` (:func:`encode_rotq_flat`): the delta vector rotated
+  through a SEEDED randomized Hadamard transform and uniform-quantized to
+  b bits per coordinate with stochastic rounding — ``b*h/8`` bytes of
+  packed codes plus four scalars (seed, bits, lo, scale) in the extra
+  block. The receiver regenerates the rotation from the seed and
+  inverse-rotates; nothing model-sized beyond the codes travels.
+- ``randk_flat`` (:func:`encode_randk_flat`): a SEEDED uniform draw of k
+  coordinates — only the k f32 values travel; the index set is
+  regenerated from the seed on the receiver (the wire advantage over
+  top-k, which must ship explicit indices).
+
+Both are deterministic functions of (input, seed): encoding the same delta
+with the same seed is byte-identical, and decode is a pure function of the
+record — the bit-identical-replay property ``tests/test_properties.py``
+pins. The per-record PRNG is ``numpy``'s Philox keyed by the record seed,
+with a fixed draw order (signs/indices FIRST, encoder-only stochastic-
+rounding uniforms after) so the decoder can stop after the shared prefix.
 """
 
 from __future__ import annotations
@@ -335,6 +356,289 @@ def encode_partial_flat(
     return _frame(serialization.msgpack_serialize(body))
 
 
+# --------------------------------------------------------------------------
+# Seeded sketch codecs: rotq_flat (rotated b-bit quantization) and
+# randk_flat (random-coordinate subsampling). Shared-seed regeneration means
+# the model-sized side information (rotation signs, index set) never travels.
+# --------------------------------------------------------------------------
+
+# Bit widths the rotq wire codec packs (byte-aligned packing below covers
+# exactly the divisors of 8). Mirrors fedtpu.ops.compression.ROTQ_BIT_WIDTHS.
+ROTQ_BITS = (1, 2, 4, 8)
+
+
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1) (fedtpu.ops.flat.next_pow2 twin —
+    local copy so the wire layer stays importable without the engine ops)."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _fwht_np(x: np.ndarray) -> np.ndarray:
+    """Unnormalized fast Walsh-Hadamard transform of a 1-D f32 vector.
+
+    Same stride-doubling butterfly as the engine kernel
+    (:func:`fedtpu.ops.pallas_kernels.hadamard_rotate`), in numpy for the
+    wire hot path (the decode side runs on the serving thread, no jax
+    dispatch). ``x.size`` must be a power of two.
+    """
+    h = x.size
+    y = np.array(x, np.float32, copy=True)
+    step = 1
+    while step < h:
+        v = y.reshape(h // (2 * step), 2, step)
+        a = v[:, 0, :].copy()
+        b = v[:, 1, :].copy()
+        v[:, 0, :] = a + b
+        v[:, 1, :] = a - b
+        step *= 2
+    return y
+
+
+def _philox(seed: int) -> np.random.Generator:
+    """The per-record PRNG: counter-based, so the stream for a seed is a
+    platform-independent pure function — the replay property both ends and
+    the tests rely on."""
+    return np.random.Generator(np.random.Philox(int(seed) & (2**64 - 1)))
+
+
+def _rotq_signs(rng: np.random.Generator, h: int) -> np.ndarray:
+    """Rademacher diagonal — the FIRST ``h`` draws of the record stream, so
+    the decoder (which needs nothing else) can stop here while the encoder
+    keeps drawing its stochastic-rounding uniforms from the same stream."""
+    return rng.integers(0, 2, size=h).astype(np.float32) * 2.0 - 1.0
+
+
+def _pack_codes(q: np.ndarray, bits: int) -> np.ndarray:
+    """Pack uint8 codes < 2**bits into a dense byte array (little-endian
+    within the byte for bits in {2, 4}; numpy's MSB-first convention for
+    bits == 1 — each is its own unpack's exact inverse)."""
+    if bits == 8:
+        return np.ascontiguousarray(q, np.uint8)
+    if bits == 1:
+        return np.packbits(np.ascontiguousarray(q, np.uint8))
+    per = 8 // bits
+    pad = (-q.size) % per
+    if pad:
+        q = np.concatenate([q, np.zeros(pad, np.uint8)])
+    q = np.ascontiguousarray(q, np.uint8).reshape(-1, per)
+    out = np.zeros(q.shape[0], np.uint8)
+    for j in range(per):
+        out |= q[:, j] << np.uint8(bits * j)
+    return out
+
+
+def _unpack_codes(codes: np.ndarray, bits: int, h: int) -> np.ndarray:
+    """Inverse of :func:`_pack_codes`; validates the byte count (untrusted
+    wire data) and returns exactly ``h`` uint8 codes."""
+    codes = np.ascontiguousarray(codes, np.uint8)
+    if codes.size != (h * bits + 7) // 8:
+        raise WireError("rotq_flat code block size mismatch")
+    if bits == 8:
+        q = codes
+    elif bits == 1:
+        q = np.unpackbits(codes)
+    else:
+        per = 8 // bits
+        mask = np.uint8((1 << bits) - 1)
+        q = np.empty(codes.size * per, np.uint8)
+        for j in range(per):
+            q[j::per] = (codes >> np.uint8(bits * j)) & mask
+    return q[:h]
+
+
+def _rotq_dequant(
+    q: np.ndarray, lo: float, scale: float, signs: np.ndarray, h: int
+) -> np.ndarray:
+    """Shared reconstruction: dequantize codes and inverse-rotate. The
+    encoder uses the SAME function for its error-feedback residual, so the
+    client's residual is computed against exactly what the server will
+    reconstruct — no encoder/decoder drift."""
+    safe = np.float32(scale) if float(scale) > 0.0 else np.float32(1.0)
+    zq = np.float32(lo) + q.astype(np.float32) * safe
+    return _fwht_np(zq) * np.float32(1.0 / math.sqrt(h)) * signs
+
+
+def encode_rotq_flat(
+    deltas: Pytree,
+    bits: int = 4,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = True,
+    seed: int = 0,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Rotated-quantization wire record (kind ``rotq_flat``).
+
+    The concatenated delta vector is zero-padded to the next power of two,
+    rotated by the seeded SRHT ``R = (1/sqrt(h)) H D`` (signs regenerated
+    from ``seed`` on both ends), and uniform-quantized to ``bits`` bits per
+    coordinate with stochastic rounding — conditionally unbiased, and the
+    rotation spreads outlier coordinates so the uniform grid wastes no
+    range. Wire cost: ``bits * h / 8`` bytes of packed codes + four scalars
+    (seed / bits / lo / scale) riding in the record's extra block — 8x
+    smaller than dense f32 at bits=4, 16x at bits=2.
+
+    Error feedback: with ``collect_residual=True`` the returned residual is
+    ``input - reconstruct(record)`` via the same :func:`_rotq_dequant` the
+    decoder runs, composing with the client's EF buffer exactly like the
+    engine codec (:func:`fedtpu.ops.compression.make_rotq`).
+
+    Same (input, seed) => byte-identical payload (Philox is counter-based).
+    """
+    if bits not in ROTQ_BITS:
+        raise ValueError(f"rotq bits must be one of {ROTQ_BITS}, got {bits}")
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else None
+    )
+    x, sizes = _flat_concat(leaves, res_leaves)
+    total = x.size
+    h = _next_pow2(max(total, 1))
+    rng = _philox(seed)
+    signs = _rotq_signs(rng, h)
+    xp = np.zeros(h, np.float32)
+    xp[:total] = x
+    z = _fwht_np(xp * signs) * np.float32(1.0 / math.sqrt(h))
+    levels = np.float32(2**bits - 1)
+    lo = np.float32(z.min())
+    scale = np.float32((z.max() - lo) / levels)
+    safe = scale if float(scale) > 0.0 else np.float32(1.0)
+    # Stochastic rounding: floor(z/safe + u), u ~ U[0,1) — E[q] recovers z
+    # exactly (conditionally unbiased given the rotation). Drawn AFTER the
+    # signs from the same stream; the decoder never needs them.
+    u = rng.random(h, dtype=np.float32)
+    q = np.clip(np.floor((z - lo) / safe + u), 0.0, float(levels)).astype(
+        np.uint8
+    )
+    body = {
+        "kind": "rotq_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "codes": _pack_codes(q, bits),
+        "extra": {
+            **(extra or {}),
+            "seed": np.uint64(seed),
+            "bits": np.int64(bits),
+            "lo": lo,
+            "scale": scale,
+        },
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    if not collect_residual:
+        return payload, None
+    back = _rotq_dequant(q, lo, scale, signs, h)
+    residual = x - back[:total]
+    return payload, _split_flat(residual, leaves, treedef)
+
+
+def _rotq_reconstruct(body: dict, total: int) -> np.ndarray:
+    """Decode a ``rotq_flat`` body to the dense ``[total]`` vector
+    (regenerate signs from the seed, dequantize, inverse-rotate, drop the
+    pow2 pad). All fields are untrusted wire data and validated."""
+    ex = body.get("extra", {})
+    try:
+        bits = int(ex["bits"])
+        seed = int(ex["seed"])
+        lo = float(ex["lo"])
+        scale = float(ex["scale"])
+    except (KeyError, TypeError, ValueError):
+        raise WireError("rotq_flat record missing codec scalars")
+    if bits not in ROTQ_BITS:
+        raise WireError(f"rotq_flat unsupported bit width {bits}")
+    if not (math.isfinite(lo) and math.isfinite(scale)) or scale < 0.0:
+        raise WireError("rotq_flat non-finite quantization scalars")
+    h = _next_pow2(max(total, 1))
+    q = _unpack_codes(np.asarray(body["codes"]), bits, h)
+    signs = _rotq_signs(_philox(seed), h)
+    return _rotq_dequant(q, np.float32(lo), np.float32(scale), signs, h)[
+        :total
+    ]
+
+
+def _randk_indices(seed: int, total: int, k: int) -> np.ndarray:
+    """The shared seeded index set: a uniform draw of k coordinates WITHOUT
+    replacement, sorted for a cache-friendly scatter. Pure function of
+    (seed, total, k) — the decoder regenerates it instead of receiving it."""
+    if total <= 0 or k <= 0:
+        return np.zeros(0, np.int64)
+    rng = _philox(seed)
+    return np.sort(rng.choice(total, size=k, replace=False).astype(np.int64))
+
+
+def encode_randk_flat(
+    deltas: Pytree,
+    fraction: float,
+    residuals: Optional[Pytree] = None,
+    extra: Optional[dict] = None,
+    collect_residual: bool = True,
+    seed: int = 0,
+) -> Tuple[bytes, Optional[Pytree]]:
+    """Random-k wire record (kind ``randk_flat``): ship only the f32 values
+    at a SEEDED uniform draw of ``k = ceil(fraction * total)`` coordinates.
+    No index block travels (the receiver regenerates it from ``seed``), so
+    the record costs ``4k`` bytes where flat top-k costs ``8k`` — the
+    importance-sampling end of the codec frontier.
+
+    Error-feedback rule (pinned, mirrors
+    :func:`fedtpu.ops.compression.make_randk`): with
+    ``collect_residual=True`` the kept values travel UNSCALED and the
+    dropped mass goes to the residual — kept + residual == input exactly,
+    the contraction EF needs. With ``collect_residual=False`` the values
+    are pre-scaled by ``total / k`` on the encoder (unbiased estimator);
+    the decoder just scatters either way.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    res_leaves = (
+        jax.tree_util.tree_flatten(residuals)[0]
+        if residuals is not None
+        else None
+    )
+    x, sizes = _flat_concat(leaves, res_leaves)
+    total = x.size
+    k = (
+        min(max(1, int(math.ceil(fraction * total))), total)
+        if total
+        else 0
+    )
+    idx = _randk_indices(seed, total, k)
+    vals = np.ascontiguousarray(x[idx], np.float32)
+    if not collect_residual and 0 < k < total:
+        vals = vals * np.float32(total / k)
+    body = {
+        "kind": "randk_flat",
+        "sizes": np.asarray(sizes, np.int64),
+        "vals": vals,
+        "extra": {
+            **(extra or {}),
+            "seed": np.uint64(seed),
+            "k": np.int64(k),
+        },
+    }
+    payload = _frame(serialization.msgpack_serialize(body))
+    if not collect_residual:
+        return payload, None
+    residual = x.copy()
+    residual[idx] = 0.0
+    return payload, _split_flat(residual, leaves, treedef)
+
+
+def _randk_scatter(body: dict, total: int, out: np.ndarray) -> None:
+    """Decode a ``randk_flat`` body into ``out[:total]`` (zeros elsewhere in
+    the real-coordinate range). Untrusted fields validated."""
+    ex = body.get("extra", {})
+    try:
+        k = int(ex["k"])
+        seed = int(ex["seed"])
+    except (KeyError, TypeError, ValueError):
+        raise WireError("randk_flat record missing codec scalars")
+    vals = np.asarray(body["vals"], np.float32)
+    if k < 0 or k > total or vals.size != k:
+        raise WireError("randk_flat k/value-block mismatch")
+    idx = _randk_indices(seed, total, k)
+    out[:total] = 0.0
+    out[idx] = vals
+
+
 def _decode_flat(body: dict, leaves, treedef) -> Pytree:
     """Reconstruct a dense delta pytree from a flat record body."""
     sizes = np.asarray(body["sizes"], np.int64)
@@ -350,6 +654,11 @@ def _decode_flat(body: dict, leaves, treedef) -> Pytree:
         dense = np.asarray(body["row"], np.float32)
         if dense.size != total:
             raise WireError("partial_flat row size mismatch with template")
+    elif body["kind"] == "rotq_flat":
+        dense = _rotq_reconstruct(body, total)
+    elif body["kind"] == "randk_flat":
+        dense = np.zeros(total, np.float32)
+        _randk_scatter(body, total, dense)
     elif body["kind"] == "topk_flat":
         idx = np.ascontiguousarray(body["idx"], np.int32)
         # Untrusted wire data: the native scatter writes unchecked.
@@ -411,7 +720,13 @@ def decode_into_row(
             f"for {total} coordinates"
         )
     kind = body.get("kind")
-    if kind in ("topk_flat", "int8_flat", "partial_flat"):
+    if kind in (
+        "topk_flat",
+        "int8_flat",
+        "partial_flat",
+        "rotq_flat",
+        "randk_flat",
+    ):
         wire_sizes = np.asarray(body["sizes"], np.int64)
         if len(wire_sizes) != len(sizes):
             raise WireError(
@@ -430,6 +745,10 @@ def decode_into_row(
             if row.size != total:
                 raise WireError("partial_flat row size mismatch with layout")
             out[:total] = row
+        elif kind == "rotq_flat":
+            out[:total] = _rotq_reconstruct(body, total)
+        elif kind == "randk_flat":
+            _randk_scatter(body, total, out)
         elif kind == "topk_flat":
             idx = np.ascontiguousarray(body["idx"], np.int32)
             # Untrusted wire data: the scatter below writes unchecked.
@@ -450,7 +769,12 @@ def decode_into_row(
                     codes[off : off + n], float(s), n
                 )
                 off += n
-        return dict(body.get("extra", {}))
+        extra = dict(body.get("extra", {}))
+        # Advisory decode-side codec tag for the per-codec wire accounting
+        # (fedtpu_rpc_bytes_*_total{codec=...}); transport-internal, popped
+        # by the server before extras reach user records.
+        extra["_codec"] = kind
+        return extra
     # Per-leaf record kinds (topk | int8): one entry per leaf, scattered
     # into the leaf's slice of the row.
     if len(body["leaves"]) != len(sizes):
@@ -474,7 +798,9 @@ def decode_into_row(
         else:
             raise WireError(f"unknown sparse kind {kind!r}")
         off += n
-    return dict(body.get("extra", {}))
+    extra = dict(body.get("extra", {}))
+    extra["_codec"] = kind
+    return extra
 
 
 def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
@@ -482,11 +808,16 @@ def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
     (deltas, extra)."""
     body = serialization.msgpack_restore(_unframe(data))
     leaves, treedef = jax.tree_util.tree_flatten(like)
-    if body.get("kind") in ("topk_flat", "int8_flat", "partial_flat"):
-        return (
-            _decode_flat(body, leaves, treedef),
-            dict(body.get("extra", {})),
-        )
+    if body.get("kind") in (
+        "topk_flat",
+        "int8_flat",
+        "partial_flat",
+        "rotq_flat",
+        "randk_flat",
+    ):
+        extra = dict(body.get("extra", {}))
+        extra["_codec"] = body["kind"]
+        return _decode_flat(body, leaves, treedef), extra
     if len(body["leaves"]) != len(leaves):
         raise WireError(
             f"sparse payload has {len(body['leaves'])} leaves, template has "
@@ -510,4 +841,6 @@ def decode(data: bytes, like: Pytree) -> Tuple[Pytree, dict]:
         else:
             raise WireError(f"unknown sparse kind {body['kind']!r}")
         out.append(dense.reshape(np.shape(leaf)).astype(np.asarray(leaf).dtype))
-    return jax.tree_util.tree_unflatten(treedef, out), dict(body.get("extra", {}))
+    extra = dict(body.get("extra", {}))
+    extra["_codec"] = body["kind"]
+    return jax.tree_util.tree_unflatten(treedef, out), extra

@@ -128,6 +128,22 @@ def encode_clients(codec, deltas, weights, base=None):
             )
         elif codec == "int8":
             payloads.append(sparse.encode_int8_flat(delta, extra=extra)[0])
+        elif codec == "rotq":
+            payloads.append(
+                sparse.encode_rotq_flat(
+                    delta, bits=8, extra=extra, collect_residual=False,
+                    seed=5,
+                )[0]
+            )
+        elif codec == "randk":
+            # fraction 0.5 on the 40-coordinate surface: k=20, so the
+            # no-EF unbiasedness rescale total/k == 2.0 is a power of two
+            # and the dyadic values stay exact through the codec.
+            payloads.append(
+                sparse.encode_randk_flat(
+                    delta, 0.5, extra=extra, collect_residual=False, seed=5
+                )[0]
+            )
         else:  # dense: full weights = base + delta, wire-framed
             tree = {
                 "params": {
@@ -142,7 +158,7 @@ def encode_clients(codec, deltas, weights, base=None):
 
 
 # ------------------------------------------------ exactness / parity pins
-@pytest.mark.parametrize("codec", ["dense", "int8", "topk"])
+@pytest.mark.parametrize("codec", ["dense", "int8", "topk", "randk"])
 def test_two_tier_parity_bitwise(codec):
     """The acceptance pin: 6 clients through codec encode -> stream decode
     -> 2 leaf partial reduces -> partial_flat wire -> root combine equals
@@ -298,6 +314,56 @@ def test_aggregator_partial_over_grpc(sim_aggregator):
     assert int(extra["clients"]) == 3
     assert agg.status_snapshot()["last_partial"]["clients"] == 3
     assert agg.status_snapshot()["mem"]["tier"] == "leaf"
+
+
+def test_two_tier_rotq_roundtrip_close():
+    """rotq through the 2-tier pipeline: the 8-bit sketch's decoded rows
+    are NOT dyadic (arbitrary lo/scale grid), so the pin is allclose
+    rather than bitwise — grouping still changes nothing beyond f32
+    summation order, and the decode really reconstructs the deltas."""
+    rng = np.random.default_rng(7)
+    deltas = dyadic_deltas(rng, 6)
+    weights = [1.0, 2.0, 4.0, 8.0, 1.0, 2.0]
+    layout = flat_ops.make_layout(TEMPLATE)
+    payloads = encode_clients("rotq", deltas, weights)
+    rows, got_w = rows_from_payloads(layout, payloads)
+    assert got_w.tolist() == weights
+    flat = np.asarray(
+        flat_weighted_mean(jnp.asarray(rows), jnp.asarray(got_w))
+    )
+    two_tier = tiered_mean(layout, rows, got_w, [(0, 1, 2), (3, 4, 5)])
+    np.testing.assert_allclose(two_tier, flat, rtol=1e-6, atol=1e-6)
+    # 8-bit fidelity: each decoded row tracks its input delta closely.
+    for i, d in enumerate(deltas):
+        ref = np.concatenate(
+            [np.ravel(l) for l in jax.tree_util.tree_leaves(d)]
+        )
+        got = rows[i, : layout.total]
+        assert np.linalg.norm(got - ref) < 0.05 * np.linalg.norm(ref)
+
+
+@pytest.mark.parametrize("codec", ["rotq", "randk"])
+def test_aggregator_partial_over_grpc_sketch_codecs(sim_aggregator, codec):
+    """Leaf aggregator ingests rotq/randk client records over live gRPC and
+    its partial_flat reply reproduces the weighted sum of the decoded
+    rows — the 2-tier compatibility pin for the new record kinds."""
+    holder, agg, stub = sim_aggregator
+    rng = np.random.default_rng(13)
+    deltas = dyadic_deltas(rng, 3)
+    holder["payloads"] = encode_clients(codec, deltas, [8.0] * 3)
+    reply = stub.SubmitPartial(
+        proto.SubmitPartialRequest(rank_base=0, world=3, round=0, epoch=1),
+        timeout=30,
+    )
+    assert reply.clients == 3
+    layout = agg._flat_layout
+    out = np.zeros((layout.padded,), np.float32)
+    extra = sparse.decode_into_row(reply.record, layout.sizes, out)
+    assert float(extra["weight_sum"]) == 24.0
+    rows, w = rows_from_payloads(layout, holder["payloads"])
+    expect = (rows * w[:, None]).sum(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    assert np.abs(out[: layout.total]).max() > 0
 
 
 def test_aggregator_fences_stale_coordinator(sim_aggregator):

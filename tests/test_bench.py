@@ -969,3 +969,82 @@ def test_fanin_microbench_committed_gate():
     assert gates["root_work_ratio_across_cohort_growth"] < 2.0
     # The fan-in sweep really grew clients ~4x while root work stayed flat.
     assert gates["root_client_growth_ratio"] >= 3.5
+
+
+@pytest.mark.slow
+def test_codec_frontier_microbench_contract(bench, monkeypatch, tmp_path):
+    """--codec-frontier-microbench at a shrunk mlp config: schema, artifact
+    emission, and the sweep invariants (dense is the 1.0x reference with
+    zero error; rotq bytes scale ~linearly in bit width; randk/topk land
+    near 1/fraction). The >=10x-at-parity gate itself is pinned by the
+    committed-artifact test below."""
+    import json as json_mod
+    import os
+
+    art = tmp_path / "artifacts"
+    monkeypatch.setattr(bench, "ARTIFACTS_DIR", str(art))
+    monkeypatch.setenv("FEDTPU_CF_MODEL", "mlp")
+    monkeypatch.setenv("FEDTPU_CF_REPS", "1")
+    monkeypatch.setenv("FEDTPU_CF_CONV_ROUNDS", "2")
+    monkeypatch.setenv("FEDTPU_CF_CONV_CLIENTS", "2")
+    result = bench._codec_frontier_microbench()
+    assert result["metric"] == "codec_frontier"
+    assert result["gate_reduction_x"] == 10.0
+    sweep = result["sweep"]["codecs"]
+    assert set(sweep) == {
+        "dense", "int8", "topk", "rotq@1b", "rotq@2b", "rotq@4b",
+        "rotq@8b", "randk",
+    }
+    dense = sweep["dense"]
+    assert dense["reduction_x"] == 1.0 and dense["rel_l2_error"] == 0.0
+    for row in sweep.values():
+        assert row["wire_bytes"] > 0
+        assert row["encode_host_ms"] > 0 and row["decode_host_ms"] > 0
+    # rotq payloads are dominated by the packed code block: bytes must
+    # scale ~linearly with bit width (pad ratio is common to all widths).
+    b1 = sweep["rotq@1b"]["wire_bytes"]
+    for bits in (2, 4, 8):
+        assert sweep[f"rotq@{bits}b"]["wire_bytes"] == pytest.approx(
+            bits * b1, rel=0.02
+        )
+    # Quantization fidelity improves monotonically with bit width.
+    assert (
+        sweep["rotq@8b"]["rel_l2_error"]
+        < sweep["rotq@4b"]["rel_l2_error"]
+        < sweep["rotq@1b"]["rel_l2_error"]
+    )
+    # int8 is ~4x (one code byte per f32) with small error.
+    assert sweep["int8"]["reduction_x"] == pytest.approx(4.0, rel=0.05)
+    assert sweep["int8"]["rel_l2_error"] < 0.05
+    conv = result["convergence"]
+    assert set(conv["runs"]) == {"none", "randk"}
+    assert conv["bytes_up_dense"] > conv["bytes_up_randk"] > 0
+    assert result["value"] == conv["reduction_x"]
+    assert result["passes_gate"] == (
+        conv["reduction_x"] >= 10.0 and conv["acc_gap"] <= result["gate_acc_tol"]
+    )
+    path = os.path.join(str(art), "CODEC_FRONTIER_MICROBENCH.json")
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert json_mod.load(f) == result
+
+
+def test_codec_frontier_committed_gate():
+    """The committed artifact is the PR's acceptance evidence: the randk
+    operating point (small keep-fraction, EF on, flat layout) cuts per-round
+    uplink bytes >=10x — real wire encoders, not an analytic byte model —
+    while the engine run converges to accuracy parity with the uncompressed
+    control within the stamped tolerance."""
+    result = _committed_artifact("CODEC_FRONTIER_MICROBENCH.json")
+    assert result["metric"] == "codec_frontier"
+    assert result["sweep"]["model"] == "densenet_cifar"
+    assert result["passes_gate"] is True
+    assert result["value"] >= 10.0
+    conv = result["convergence"]
+    assert conv["error_feedback"] is True
+    assert conv["acc_gap"] <= result["gate_acc_tol"]
+    assert conv["reduction_x"] >= 10.0
+    # The sweep really exercised the whole family at the profile shape.
+    assert set(result["sweep"]["codecs"]) >= {
+        "dense", "int8", "topk", "rotq@4b", "randk",
+    }

@@ -478,6 +478,52 @@ def test_corrupt_reply_is_rejected_and_retried():
 
 
 @pytest.mark.filterwarnings("ignore::DeprecationWarning")
+# Both kinds share the CRC-reject/retry path; randk runs tier-1, the rotq
+# twin rides the slow tier (its record-level corruption rejection is also
+# pinned cheaply in test_sparse_wire).
+@pytest.mark.parametrize(
+    "codec", [pytest.param("rotq", marks=pytest.mark.slow), "randk"]
+)
+def test_corrupt_sketch_record_is_rejected_and_retried(codec):
+    """A rotq/randk record corrupted in flight fails the FSP1 CRC like any
+    other sparse reply: classified transient, re-requested once, full
+    participation, nobody marked dead — the new record kinds inherit the
+    whole retry path. The retried round's per-codec accounting still labels
+    the bytes with the sketch codec."""
+    pytest.importorskip("grpc")
+    from fedtpu.transport.federation import PrimaryServer, serve_client
+
+    cfg = tiny_cfg(
+        2,
+        retry=RetryPolicy(max_attempts=3, backoff_s=0.01),
+        compression=codec,
+        topk_fraction=0.1,
+        delta_layout="flat",
+        error_feedback=True,
+    )
+    chaos = parse_spec("corrupt@StartTrain:p=1.0,max=1,seed=0")
+    servers, addrs = [], []
+    try:
+        for i in range(2):
+            addr = f"localhost:{free_port()}"
+            server, _ = serve_client(addr, cfg, seed=i)
+            servers.append(server)
+            addrs.append(addr)
+        primary = PrimaryServer(cfg, addrs, chaos=chaos)
+        rec = primary.round()
+        assert rec["participants"] == 2 and rec["alive"] == [True, True]
+        assert primary.telemetry.registry.counter(
+            "fedtpu_rpc_retries_total", labels={"rpc": "StartTrain"}
+        ).value == 1
+        assert chaos.injected_total() == 1
+        by_codec = rec["bytes_up_by_codec"]
+        assert set(by_codec) == {codec} and by_codec[codec] > 0
+    finally:
+        for s in servers:
+            s.stop(0)
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
 def test_exhausted_retries_do_reach_mark_failed():
     """The inverse contract: a NON-transient outage (faults outlasting the
     whole retry budget) must still mark the client dead — retries absorb

@@ -675,6 +675,7 @@ def test_perf_baseline_committed_artifact_contract():
         "round_record_us", "prometheus_render_us", "trace_merge_us",
         "gap_analyze_us", "mixed_precision_cast_us", "megabatch_reshape_us",
         "partial_reduce_fold_us", "submit_partial_frame_us",
+        "hadamard_rotate_us", "randk_gather_us",
     }
     assert set(baseline["metrics"]) == expected
     for row in baseline["metrics"].values():

@@ -7,28 +7,120 @@ mass exactly, robust combiners match NumPy on arbitrary masks, and the wire
 codec roundtrips arbitrary pytrees and detects corruption.
 """
 
+import functools
+import zlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
 
 # Environment gate, not a correctness gate: the container has no
-# `hypothesis` wheel and installs are not allowed; without this guard the
-# module is a COLLECTION ERROR, which poisons the tier-1 dots count. With
-# it, the module is an honest skip wherever hypothesis is absent and runs
-# in full wherever it exists.
-hypothesis = pytest.importorskip(
-    "hypothesis", reason="hypothesis not installed in this environment"
-)
-from hypothesis import given, settings, strategies as st  # noqa: E402
+# `hypothesis` wheel and installs are not allowed. Where hypothesis exists
+# these tests run under it in full (shrinking, example database, coverage-
+# guided generation); where it is absent they fall back to a deterministic
+# stub that draws the same number of examples from seeded numpy — weaker
+# exploration, but the invariants still execute on every tier-1 run instead
+# of skipping wholesale.
+try:
+    from hypothesis import given, settings, strategies as st
 
-from fedtpu.core.round import _dp_clip, _robust_over_clients
-from fedtpu.data import partition
-from fedtpu.transport import wire
+    HAS_HYPOTHESIS = True
+except ImportError:  # deterministic fallback — no new dependency
+    HAS_HYPOTHESIS = False
+
+    # Tier-1 time budget: the stub draws far fewer examples than
+    # hypothesis's default 100 — shrinking/coverage come back whenever
+    # the real library is installed; the stub only keeps the properties
+    # EXERCISED (seeded, so a failing draw is reproducible by name).
+    _STUB_EXAMPLES = 6
+
+    class _Strategy:
+        """Minimal strategy: a seeded-rng -> value draw, composable with
+        the two combinators this module uses (map / flatmap)."""
+
+        def __init__(self, draw):
+            self._draw = draw
+
+        def map(self, f):
+            return _Strategy(lambda rng: f(self._draw(rng)))
+
+        def flatmap(self, f):
+            return _Strategy(lambda rng: f(self._draw(rng))._draw(rng))
+
+    class _St:
+        @staticmethod
+        def integers(lo, hi):
+            return _Strategy(lambda rng: int(rng.integers(lo, hi + 1)))
+
+        @staticmethod
+        def floats(lo, hi):
+            return _Strategy(lambda rng: float(rng.uniform(lo, hi)))
+
+        @staticmethod
+        def booleans():
+            return _Strategy(lambda rng: bool(rng.integers(0, 2)))
+
+        @staticmethod
+        def sampled_from(seq):
+            items = list(seq)
+            return _Strategy(
+                lambda rng: items[int(rng.integers(0, len(items)))]
+            )
+
+        @staticmethod
+        def dictionaries(keys, values, min_size=0, max_size=5):
+            def draw(rng):
+                size = int(rng.integers(max(min_size, 1), max_size + 1))
+                out = {}
+                for _ in range(4 * size):  # duplicate keys collapse
+                    if len(out) >= size:
+                        break
+                    out[keys._draw(rng)] = values._draw(rng)
+                return out
+
+            return _Strategy(draw)
+
+    st = _St()
+
+    def given(**kw):
+        def deco(fn):
+            @functools.wraps(fn)
+            def run():
+                # Per-test seed from the name: stable across runs and
+                # independent of execution order.
+                rng = np.random.default_rng(zlib.crc32(fn.__name__.encode()))
+                for _ in range(_STUB_EXAMPLES):
+                    fn(**{k: s._draw(rng) for k, s in kw.items()})
+
+            # pytest follows __wrapped__ to the original signature and
+            # would demand fixtures named after the drawn arguments.
+            del run.__wrapped__
+            return run
+
+        return deco
+
+    def settings(**_kw):
+        return lambda fn: fn
+
+
+from fedtpu.core.round import _dp_clip, _robust_over_clients  # noqa: E402
+from fedtpu.data import partition  # noqa: E402
+from fedtpu.transport import sparse, wire  # noqa: E402
 
 _slow = settings(max_examples=25, deadline=None)
 
+# The suites above the sketch-codec section predate the stub: without real
+# hypothesis this module used to skip wholesale, so running them under the
+# stub re-buys ~9 s of tier-1 wall for coverage the seed never had.  They
+# stay hypothesis-only; the sketch-codec properties below run in both modes.
+_hypothesis_only = pytest.mark.skipif(
+    not HAS_HYPOTHESIS,
+    reason="needs real hypothesis; the stub runs only the sketch-codec properties",
+)
 
+
+@_hypothesis_only
 @_slow
 @given(
     n_examples=st.integers(4, 300),
@@ -42,6 +134,7 @@ def test_round_robin_is_an_exact_disjoint_cover(n_examples, n_clients, batch):
     assert sorted(taken.tolist()) == list(range(n_batches * batch))
 
 
+@_hypothesis_only
 @_slow
 @given(n_examples=st.integers(2, 400), n_clients=st.integers(1, 10),
        seed=st.integers(0, 5))
@@ -51,6 +144,7 @@ def test_iid_is_an_exact_disjoint_cover(n_examples, n_clients, seed):
     assert taken == list(range(n_examples))
 
 
+@_hypothesis_only
 @_slow
 @given(n=st.integers(20, 200), clients=st.integers(2, 8),
        alpha=st.floats(0.1, 5.0), seed=st.integers(0, 3))
@@ -61,6 +155,7 @@ def test_dirichlet_is_an_exact_disjoint_cover(n, clients, alpha, seed):
     assert sorted(idx[mask].tolist()) == list(range(n))
 
 
+@_hypothesis_only
 @_slow
 @given(
     rows=st.integers(1, 6),
@@ -83,6 +178,7 @@ def test_dp_clip_bound_always_holds(rows, cols, clip, scale, seed):
     assert (np.sqrt(sq) <= clip * (1 + 1e-4) + 1e-7).all()
 
 
+@_hypothesis_only
 @_slow
 @given(
     n=st.integers(1, 9),
@@ -116,6 +212,7 @@ def _tree_strategy():
     )
 
 
+@_hypothesis_only
 @_slow
 @given(tree=_tree_strategy(), compress=st.booleans())
 def test_wire_roundtrip_arbitrary_trees(tree, compress):
@@ -126,6 +223,7 @@ def test_wire_roundtrip_arbitrary_trees(tree, compress):
         np.testing.assert_array_equal(np.asarray(out[k]), tree[k])
 
 
+@_hypothesis_only
 @_slow
 @given(tree=_tree_strategy(), pos_frac=st.floats(0.0, 1.0))
 def test_wire_detects_payload_corruption(tree, pos_frac):
@@ -140,6 +238,7 @@ def test_wire_detects_payload_corruption(tree, pos_frac):
         wire.decode(bytes(blob), like)
 
 
+@_hypothesis_only
 @_slow
 @given(
     n=st.integers(2, 8),
@@ -161,6 +260,7 @@ def test_trimmed_mean_stays_within_live_range(n, cols, trim, seed):
     assert (out >= lo - 1e-4).all() and (out <= hi + 1e-4).all()
 
 
+@_hypothesis_only
 @_slow
 @given(
     n=st.integers(3, 10),
@@ -198,6 +298,7 @@ def test_screening_stats_are_permutation_equivariant(
         )
 
 
+@_hypothesis_only
 @_slow
 @given(
     n=st.integers(3, 10),
@@ -236,6 +337,7 @@ def test_screening_relative_stats_are_scale_invariant(n, cols, seed, scale):
     )
 
 
+@_hypothesis_only
 @_slow
 @given(
     n=st.integers(2, 12),
@@ -281,3 +383,126 @@ def test_fedbuff_damped_update_never_exceeds_normalized(n, k, power, seed):
         np.testing.assert_allclose(damped, normalized, rtol=1e-6)
     else:
         assert damp < 1.0  # power > 0 and a stale arrival MUST damp
+
+
+# --------------------------------------------------------------------------
+# Sketch-codec invariants (rotq / randk wire records). These are the three
+# properties the adaptive codec controller leans on: unbiasedness (so codec
+# switches don't inject drift), bit-identical seeded replay (so a retried
+# or replayed round re-encodes the same bytes), and EF algebra (so the
+# residual really is the dropped mass).
+
+
+@_slow
+@given(n=st.integers(16, 400), seed=st.integers(0, 1000))
+def test_rotq_wire_is_unbiased_over_seeds(n, seed):
+    """E_seed[decode(encode(x))] == x: the rotation pair is exactly inverse
+    and stochastic rounding is conditionally unbiased, so averaging the
+    reconstruction over many sketch seeds must beat any single seed's
+    quantization error by ~1/sqrt(S) — a bias would plateau instead."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    like = {"a": np.zeros_like(x)}
+    S = 32
+    recons, errs = [], []
+    for s in range(S):
+        payload, _ = sparse.encode_rotq_flat(
+            {"a": x}, bits=2, collect_residual=False, seed=seed * S + s
+        )
+        got = np.asarray(sparse.decode(payload, like)[0]["a"], np.float64)
+        recons.append(got)
+        errs.append(float(np.linalg.norm(got - x)))
+    mean_err = float(np.linalg.norm(np.mean(recons, axis=0) - x))
+    avg_err = float(np.mean(errs))
+    if avg_err > 1e-6:  # degenerate constant rows quantize exactly
+        # Unbiased averaging over 32 seeds predicts ~avg/sqrt(32) ~ 0.18x;
+        # 0.6x leaves headroom for seed-to-seed variance without letting a
+        # real bias (which would keep mean_err ~ avg_err) through.
+        assert mean_err < 0.6 * avg_err, (mean_err, avg_err)
+
+
+@_slow
+@given(n=st.integers(16, 400), frac=st.floats(0.05, 0.5),
+       seed=st.integers(0, 1000))
+def test_randk_wire_is_unbiased_over_seeds(n, frac, seed):
+    """Without error feedback the kept coordinates are rescaled by total/k,
+    so E_seed[decode(encode(x))] == x over the uniform coordinate draw."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    like = {"a": np.zeros_like(x)}
+    S = 64
+    recons, errs = [], []
+    for s in range(S):
+        payload, _ = sparse.encode_randk_flat(
+            {"a": x}, frac, collect_residual=False, seed=seed * S + s
+        )
+        got = np.asarray(sparse.decode(payload, like)[0]["a"], np.float64)
+        recons.append(got)
+        errs.append(float(np.linalg.norm(got - x)))
+    mean_err = float(np.linalg.norm(np.mean(recons, axis=0) - x))
+    avg_err = float(np.mean(errs))
+    if avg_err > 1e-6:  # keep-all budgets reconstruct exactly
+        assert mean_err < 0.6 * avg_err, (mean_err, avg_err)
+
+
+@_slow
+@given(n=st.integers(16, 300), seed=st.integers(0, 10_000),
+       bits=st.sampled_from([1, 2, 4, 8]), frac=st.floats(0.05, 0.5))
+def test_sketch_wire_replay_is_bit_identical(n, seed, bits, frac):
+    """Same (input, seed) -> byte-identical payload; a different seed
+    rotates/samples differently. This is what lets a replayed round
+    (recovery, retry) re-encode the exact bytes the first attempt shipped."""
+    rng = np.random.default_rng(seed)
+    x = {"a": rng.normal(size=n).astype(np.float32)}
+    p1, _ = sparse.encode_rotq_flat(x, bits=bits, collect_residual=False,
+                                    seed=seed)
+    p2, _ = sparse.encode_rotq_flat(x, bits=bits, collect_residual=False,
+                                    seed=seed)
+    assert p1 == p2
+    p3, _ = sparse.encode_rotq_flat(x, bits=bits, collect_residual=False,
+                                    seed=seed + 1)
+    assert p1 != p3  # fresh sign vector over >=16 coords
+    q1, _ = sparse.encode_randk_flat(x, frac, collect_residual=False,
+                                     seed=seed)
+    q2, _ = sparse.encode_randk_flat(x, frac, collect_residual=False,
+                                     seed=seed)
+    assert q1 == q2
+
+
+@_slow
+@given(n=st.integers(8, 300), frac=st.floats(0.05, 0.6),
+       seed=st.integers(0, 1000))
+def test_randk_wire_ef_residual_is_exactly_the_dropped_mass(n, frac, seed):
+    """With error feedback the kept values ship UNSCALED and the residual
+    is the complement: decode(payload) + residual == input bit-exactly
+    (disjoint coordinate sets — no float cancellation)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    payload, res = sparse.encode_randk_flat(
+        {"a": x}, frac, collect_residual=True, seed=seed
+    )
+    got = np.asarray(sparse.decode(payload, {"a": np.zeros_like(x)})[0]["a"])
+    np.testing.assert_array_equal(got + np.asarray(res["a"]), x)
+    # Contraction: the residual is a strict subset of the input's mass.
+    assert np.linalg.norm(res["a"]) <= np.linalg.norm(x) + 1e-7
+
+
+@_slow
+@given(n=st.integers(8, 300), seed=st.integers(0, 1000))
+def test_rotq_wire_ef_residual_closes_the_algebra(n, seed):
+    """decode(payload) + residual == input up to f32 addition rounding —
+    the encoder derives the residual from the SAME dequantized values the
+    decoder reconstructs (shared _rotq_dequant), so EF never drifts from
+    what the server actually applied. At 8 bits the quantization noise
+    (and with it the residual) is small next to the input."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    payload, res = sparse.encode_rotq_flat(
+        {"a": x}, bits=8, collect_residual=True, seed=seed
+    )
+    got = np.asarray(sparse.decode(payload, {"a": np.zeros_like(x)})[0]["a"])
+    np.testing.assert_allclose(got + np.asarray(res["a"]), x,
+                               rtol=1e-5, atol=1e-5)
+    nx = float(np.linalg.norm(x))
+    if nx > 1e-6:
+        assert float(np.linalg.norm(res["a"])) < 0.1 * nx

@@ -277,6 +277,8 @@ def _row_from_tree(tree):
     (sparse.encode_int8, {}),
     (sparse.encode_topk_flat, {"fraction": 0.1}),
     (sparse.encode_int8_flat, {}),
+    (sparse.encode_rotq_flat, {"bits": 4, "seed": 3}),
+    (sparse.encode_randk_flat, {"fraction": 0.1, "seed": 3}),
 ])
 def test_decode_into_row_matches_tree_decode(rng, encoder, kwargs):
     """The streaming server's row-target decode reconstructs EXACTLY what
@@ -344,3 +346,107 @@ def test_dense_wire_decode_into_row(rng):
     expect = _row_from_tree(model) - _row_from_tree(base)
     np.testing.assert_array_equal(out[: sum(sizes)], expect)
     np.testing.assert_array_equal(out[sum(sizes):], 0.0)
+
+
+# ------------------------------------------------- sketch records (rotq/randk)
+def test_rotq_flat_roundtrip_error_bound(rng):
+    """8-bit rotated-sketch record reconstructs within ~2% relative L2 and
+    replays byte-identically from the same seed."""
+    tree = delta_tree(rng)
+    payload, _ = sparse.encode_rotq_flat(tree, bits=8, seed=11)
+    replay, _ = sparse.encode_rotq_flat(tree, bits=8, seed=11)
+    assert payload == replay
+    other, _ = sparse.encode_rotq_flat(tree, bits=8, seed=12)
+    assert payload != other
+    got, extra = sparse.decode(payload, zeros_like_tree(tree))
+    assert extra["_codec"] == "rotq_flat"
+    ref, out = _row_from_tree(tree), _row_from_tree(got)
+    assert np.linalg.norm(out - ref) < 0.02 * np.linalg.norm(ref)
+
+
+def test_rotq_flat_error_feedback_carries(rng):
+    """Residual == input - reconstruction, derived from the SAME dequantized
+    values the decoder produces (shared helper, no encoder/decoder drift)."""
+    import jax
+
+    tree = delta_tree(rng)
+    payload, res = sparse.encode_rotq_flat(tree, bits=4, seed=5)
+    got, _ = sparse.decode(payload, zeros_like_tree(tree))
+    lhs = _row_from_tree(jax.tree.map(np.add, got, res))
+    np.testing.assert_allclose(lhs, _row_from_tree(tree), rtol=1e-5, atol=1e-5)
+
+
+def test_randk_flat_ef_and_rescale_modes(rng):
+    """EF on: unscaled values, decode + residual == input exactly. EF off:
+    the decoded kept coordinates carry the total/k unbiasedness rescale."""
+    import jax
+    import math
+
+    tree = delta_tree(rng)
+    payload, res = sparse.encode_randk_flat(tree, 0.1, seed=9)
+    got, extra = sparse.decode(payload, zeros_like_tree(tree))
+    assert extra["_codec"] == "randk_flat"
+    lhs = _row_from_tree(jax.tree.map(np.add, got, res))
+    np.testing.assert_array_equal(lhs, _row_from_tree(tree))
+
+    payload2, res2 = sparse.encode_randk_flat(
+        tree, 0.1, seed=9, collect_residual=False
+    )
+    assert res2 is None
+    got2, _ = sparse.decode(payload2, zeros_like_tree(tree))
+    row, ref = _row_from_tree(got2), _row_from_tree(tree)
+    total = ref.size
+    k = max(1, int(math.ceil(0.1 * total)))
+    mask = row != 0
+    np.testing.assert_allclose(row[mask], ref[mask] * (total / k), rtol=1e-6)
+    # Same seed -> same support in both modes.
+    np.testing.assert_array_equal(mask, _row_from_tree(got) != 0)
+
+
+def test_sketch_records_reject_corruption_and_bad_fields(rng):
+    from flax import serialization
+
+    tree = delta_tree(rng)
+    for payload in (
+        sparse.encode_rotq_flat(tree, bits=2, seed=1)[0],
+        sparse.encode_randk_flat(tree, 0.1, seed=1)[0],
+    ):
+        blob = bytearray(payload)
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(WireError):
+            sparse.decode(bytes(blob), zeros_like_tree(tree))
+
+    sizes = _layout_sizes(tree)
+    total = sum(sizes)
+    out = np.zeros((total,), np.float32)
+
+    def frame(body):
+        return sparse._frame(serialization.msgpack_serialize(body))
+
+    # Unsupported bit width in a hand-built record.
+    h = sparse._next_pow2(total)
+    bad_bits = frame({
+        "kind": "rotq_flat", "sizes": np.asarray(sizes, np.int64),
+        "codes": np.zeros((h,), np.uint8),
+        "extra": {"seed": np.uint64(0), "bits": np.int64(3),
+                  "lo": np.float32(0), "scale": np.float32(1)},
+    })
+    with pytest.raises(WireError):
+        sparse.decode_into_row(bad_bits, sizes, out)
+    # Truncated code block.
+    short = frame({
+        "kind": "rotq_flat", "sizes": np.asarray(sizes, np.int64),
+        "codes": np.zeros((3,), np.uint8),
+        "extra": {"seed": np.uint64(0), "bits": np.int64(8),
+                  "lo": np.float32(0), "scale": np.float32(1)},
+    })
+    with pytest.raises(WireError):
+        sparse.decode_into_row(short, sizes, out)
+    # randk with a value count that disagrees with k.
+    bad_k = frame({
+        "kind": "randk_flat", "sizes": np.asarray(sizes, np.int64),
+        "vals": np.zeros((4,), np.float32),
+        "extra": {"seed": np.uint64(0), "k": np.int64(9)},
+    })
+    with pytest.raises(WireError):
+        sparse.decode_into_row(bad_k, sizes, out)

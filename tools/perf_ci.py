@@ -193,6 +193,34 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
         struct.pack("<4sBBI", b"FSP1", 1, 0,
                     zlib.crc32(payload) & 0xFFFFFFFF) + payload
 
+    # Sketch-codec host costs (PR: rotated-sketch + random-k wire codecs).
+    # The two hot loops the codecs add on the HOST side of the edge: the
+    # in-place FWHT butterfly over the padded row (the encoder/decoder both
+    # run it once per record — numpy stands in for transport.sparse._fwht_np
+    # which IS numpy, so this times the real algorithm), and the seeded
+    # Philox index draw + gather that builds a randk record. A regression
+    # here means someone replaced the O(h log h) butterfly with a dense
+    # h x h matmul, or the sorted no-replacement draw with a per-coordinate
+    # Python loop.
+    had_row = np.arange(4096, dtype=np.float32)
+
+    def hadamard_rotate_one():
+        x = had_row.copy()
+        h = x.size
+        step = 1
+        while step < h:
+            y = x.reshape(h // (2 * step), 2, step)
+            a, b = y[:, 0, :], y[:, 1, :]
+            x = np.concatenate([a + b, a - b], axis=1).reshape(h)
+            step *= 2
+
+    randk_x = np.arange(32768, dtype=np.float32)
+
+    def randk_gather_one():
+        rng = np.random.Generator(np.random.Philox(7))
+        idx = np.sort(rng.choice(randk_x.size, size=1638, replace=False))
+        randk_x[idx]
+
     def span_one():
         with tel.span("perf_ci", round=0):
             pass
@@ -223,6 +251,8 @@ def _build_workloads() -> List[Tuple[str, Callable[[], None], int, object]]:
         ("megabatch_reshape_us", megabatch_reshape_one, 5000, None),
         ("partial_reduce_fold_us", partial_reduce_fold_one, 500, None),
         ("submit_partial_frame_us", submit_partial_frame_one, 500, None),
+        ("hadamard_rotate_us", hadamard_rotate_one, 200, None),
+        ("randk_gather_us", randk_gather_one, 200, None),
     ]
 
 
